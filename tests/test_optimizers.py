@@ -1,0 +1,91 @@
+"""End-to-end optimizer tests against the reference's golden accuracies
+(BASELINE.md): LR 0.9415, SSGD 0.9298, MA 0.8538, BMUF 0.9298, EASGD 0.9298
+on breast-cancer 70/30. Our runs use different (seeded) inits and f32, so we
+assert convergence into the same quality band rather than bit equality.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_distalg.models import bmuf, easgd, logistic_regression, ma, ssgd
+
+
+def test_ssgd_converges(mesh8, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    res = ssgd.train(
+        X_train, y_train, X_test, y_test, mesh8,
+        ssgd.SSGDConfig(n_iterations=1500),
+    )
+    assert res.final_acc >= 0.90, res.final_acc
+    assert res.accs.shape == (1500,)
+
+
+def test_ssgd_with_l2(mesh8, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    res = ssgd.train(
+        X_train, y_train, X_test, y_test, mesh8,
+        ssgd.SSGDConfig(n_iterations=1500, lam=1e-4, reg_type="l2"),
+    )
+    assert res.final_acc >= 0.88
+
+
+def test_full_batch_lr_converges(mesh8, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    res = logistic_regression.train(
+        X_train, y_train, X_test, y_test, mesh8,
+        logistic_regression.LRConfig(n_iterations=1500),
+    )
+    assert res.final_acc >= 0.92, res.final_acc
+
+
+def test_ma_converges(mesh4, cancer_data):
+    """4 replicas matching the reference's n_slices=4; MA's golden acc is
+    only 0.8538 (ma.py:131) — assert at least that band."""
+    X_train, y_train, X_test, y_test = cancer_data
+    res = ma.train(
+        X_train, y_train, X_test, y_test, mesh4,
+        ma.MAConfig(n_iterations=300),
+    )
+    assert res.final_acc >= 0.83, res.final_acc
+
+
+def test_bmuf_converges(mesh4, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    res = bmuf.train(
+        X_train, y_train, X_test, y_test, mesh4,
+        bmuf.BMUFConfig(n_iterations=300),
+    )
+    assert res.final_acc >= 0.88, res.final_acc
+
+
+def test_easgd_converges(mesh4, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    res = easgd.train(
+        X_train, y_train, X_test, y_test, mesh4,
+        easgd.EASGDConfig(n_iterations=1500),
+    )
+    assert res.final_acc >= 0.88, res.final_acc
+
+
+def test_ssgd_topology_independence(mesh1, mesh8, cancer_data):
+    """SURVEY.md §4: n-device result ≡ 1-device result. The Bernoulli masks
+    come from the partitionable PRNG keyed by row position, so the only
+    cross-topology difference is float reduction order."""
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=50)
+    r1 = ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg)
+    r8 = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg)
+    np.testing.assert_allclose(
+        np.asarray(r1.w), np.asarray(r8.w), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_local_sgd_resample_mode(mesh4, cancer_data):
+    """Fresh minibatch per local step (the non-parity improvement flag)."""
+    X_train, y_train, X_test, y_test = cancer_data
+    res = ma.train(
+        X_train, y_train, X_test, y_test, mesh4,
+        ma.MAConfig(n_iterations=100, resample_per_local_step=True),
+    )
+    assert res.final_acc >= 0.80

@@ -1,0 +1,193 @@
+"""Golden-value tests for k-means, PageRank, transitive closure, ALS and
+Monte Carlo against the reference's known answers (SURVEY.md §4 item 2:
+known-answer workloads are the reference's de-facto test strategy)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_distalg.models import als, kmeans, monte_carlo, pagerank, transitive_closure
+from tpu_distalg.utils import datasets
+
+
+# ---------------------------------------------------------------- k-means
+
+def test_kmeans_toy_matrix(mesh8):
+    """The reference's 6x2 matrix separates into x≈1 and x≈10 columns
+    (k-means.py:49-50); cluster means are (1,2) and (10,2)."""
+    res = kmeans.fit(datasets.toy_kmeans_matrix(), mesh8)
+    centers = np.asarray(res.centers)
+    centers = centers[np.argsort(centers[:, 0])]
+    np.testing.assert_allclose(centers, [[1.0, 2.0], [10.0, 2.0]], atol=1e-5)
+
+
+def test_kmeans_assignments_match_centers(mesh8):
+    res = kmeans.fit(datasets.toy_kmeans_matrix(), mesh8)
+    a = np.asarray(res.assignments)[:6]
+    # first three points together, last three together
+    assert len(set(a[:3])) == 1 and len(set(a[3:])) == 1 and a[0] != a[3]
+
+
+def test_kmeans_gaussian_mixture_converge_mode(mesh8):
+    pts = datasets.gaussian_mixture(4096, k=4, seed=3)
+    res = kmeans.fit(
+        pts, mesh8,
+        kmeans.KMeansConfig(k=4, converge_dist=1e-3, seed=0),
+    )
+    assert res.n_iterations_run < 1000  # converged, not capped
+    # every point is close to its assigned center
+    centers = np.asarray(res.centers)
+    a = np.asarray(res.assignments)[: len(pts)]
+    d = np.linalg.norm(pts - centers[a], axis=1)
+    assert d.mean() < 3.0
+
+
+def test_kmeans_empty_cluster_keeps_old_center(mesh8):
+    """A center with no points must survive unchanged (k-means.py:66-71
+    only overwrites ids present in the collect)."""
+    pts = np.array([[0.0, 0.0], [0.1, 0.0], [0.0, 0.1]], dtype=np.float32)
+    import tpu_distalg.ops.kmeans as kops
+
+    sums = jnp.zeros((2, 2))
+    counts = jnp.array([0.0, 3.0])
+    old = jnp.array([[5.0, 5.0], [1.0, 1.0]])
+    new = kops.update_centers(sums, counts, old)
+    np.testing.assert_allclose(np.asarray(new)[0], [5.0, 5.0])
+
+
+# ---------------------------------------------------------------- pagerank
+
+def test_pagerank_toy_matches_reference_golden(mesh8):
+    """Exact parity with pagerank.py:66-68 recorded output."""
+    res = pagerank.run(datasets.toy_graph_edges(), mesh8)
+    ranks = np.asarray(res.ranks)
+    np.testing.assert_allclose(
+        ranks,
+        [0.38891305880091237, 0.214416470596171, 0.3966704706029163],
+        atol=1e-5,
+    )
+
+
+def test_pagerank_duplicate_edges_ignored(mesh8):
+    """links.distinct() semantics (pagerank.py:41): duplicates don't
+    change the result."""
+    edges = datasets.toy_graph_edges()
+    doubled = np.concatenate([edges, edges], axis=0)
+    r1 = pagerank.run(edges, mesh8)
+    r2 = pagerank.run(doubled, mesh8)
+    np.testing.assert_allclose(
+        np.asarray(r1.ranks), np.asarray(r2.ranks), atol=1e-6
+    )
+
+
+def test_pagerank_standard_mode_conserves_mass(mesh8):
+    edges = datasets.erdos_renyi_edges(1000, 6.0, seed=1)
+    res = pagerank.run(
+        edges, mesh8, pagerank.PageRankConfig(mode="standard")
+    )
+    assert abs(float(jnp.sum(res.ranks)) - 1.0) < 1e-3
+    assert float(jnp.min(res.ranks)) > 0
+
+
+def test_pagerank_reference_mode_drops_sink_mass(mesh8):
+    """A sink vertex (no out-links) loses its mass in reference mode —
+    the documented no-dangling-handling quirk (SURVEY.md §2.1 row 7)."""
+    edges = np.array([[0, 1], [1, 2]])  # 2 is a sink
+    res = pagerank.run(edges, mesh8, pagerank.PageRankConfig(n_iterations=3))
+    total = float(jnp.sum(res.ranks))
+    assert total < 1.0  # mass vanished, matching the reference
+
+
+# ------------------------------------------------------- transitive closure
+
+def test_closure_toy_graph(mesh8):
+    """1→2,1→3,2→3,3→1 closes to all 9 ordered pairs over {1,2,3}."""
+    res = transitive_closure.run(datasets.toy_graph_edges(), mesh8)
+    assert res.n_paths == 9
+    paths = np.asarray(res.paths)[:3, :3]
+    assert paths.all()
+
+
+def test_closure_chain(mesh8):
+    """Chain 0→1→2→3: closure has n(n-1)/2 = 6 pairs, found in O(log) rounds."""
+    edges = np.array([[0, 1], [1, 2], [2, 3]])
+    res = transitive_closure.run(edges, mesh8)
+    assert res.n_paths == 6
+    assert res.n_rounds <= 3
+
+
+def test_closure_no_new_paths_terminates_immediately(mesh8):
+    """A complete closure (self-loop pair) stabilises in one round."""
+    edges = np.array([[0, 1], [1, 0]])
+    res = transitive_closure.run(edges, mesh8)
+    # 0→1,1→0 closes to {00,01,10,11}
+    assert res.n_paths == 4
+
+
+# ---------------------------------------------------------------------- als
+
+def test_als_regularized_converges(mesh8):
+    res = als.fit(mesh8)
+    errs = np.asarray(res.rmse_history)
+    assert errs[-1] < 0.05  # regularization floor with lam=0.01
+    assert errs[-1] < errs[0]
+
+
+def test_als_unregularized_recovers_rank_k(mesh8):
+    """R is exactly rank k (matrix_decomposition.py:42): with λ=0 ALS must
+    recover it to numerical precision."""
+    res = als.fit(mesh8, als.ALSConfig(lam=0.0))
+    assert res.final_rmse < 1e-3
+    assert res.U.shape == (100, 10) and res.V.shape == (500, 10)
+
+
+def test_als_matches_reference_solver_one_sweep(mesh1):
+    """One U-half-sweep equals the reference's per-row
+    solve((VᵀV+λ·n·I), Vᵀ R[i,:]) in float64 NumPy."""
+    cfg = als.ALSConfig(m=16, n=24, k=4, n_iterations=1, lam=0.01)
+    rng = np.random.default_rng(0)
+    R = rng.random((cfg.m, cfg.n)).astype(np.float32)
+    V0 = rng.random((cfg.n, cfg.k))
+
+    # reference formula (float64)
+    XtX = V0.T @ V0 + cfg.lam * cfg.n * np.eye(cfg.k)
+    expect_U = np.stack(
+        [np.linalg.solve(XtX, V0.T @ R[i, :]) for i in range(cfg.m)]
+    )
+
+    from tpu_distalg.ops import linalg
+
+    G = linalg.gram(jnp.asarray(V0, jnp.float32), cfg.lam, cfg.n)
+    got_U = linalg.solve_factor_block(
+        G, jnp.asarray(V0, jnp.float32), jnp.asarray(R)
+    )
+    np.testing.assert_allclose(np.asarray(got_U), expect_U, atol=2e-4)
+
+
+# -------------------------------------------------------------- monte carlo
+
+def test_monte_carlo_pi(mesh8):
+    pi, n_used = monte_carlo.estimate_pi(mesh8)
+    assert n_used >= 400_000
+    assert abs(pi - np.pi) < 0.02  # reference prints "roughly 3.14"
+
+
+def test_monte_carlo_deterministic_given_seed(mesh8):
+    p1, _ = monte_carlo.estimate_pi(mesh8)
+    p2, _ = monte_carlo.estimate_pi(mesh8)
+    p3, _ = monte_carlo.estimate_pi(
+        mesh8, monte_carlo.MonteCarloConfig(seed=7)
+    )
+    assert p1 == p2
+    assert p1 != p3  # different seed, different estimate
+
+
+def test_monte_carlo_chunking_equivalence(mesh8):
+    """Chunk size must not change the drawn darts' statistics materially."""
+    big, _ = monte_carlo.estimate_pi(
+        mesh8, monte_carlo.MonteCarloConfig(n=200_000, chunk=1 << 20)
+    )
+    small, _ = monte_carlo.estimate_pi(
+        mesh8, monte_carlo.MonteCarloConfig(n=200_000, chunk=1 << 12)
+    )
+    assert abs(big - small) < 0.05

@@ -1,0 +1,22 @@
+"""tpu-distalg — a TPU-native distributed-algorithms framework.
+
+A from-scratch JAX/XLA re-design of the capability surface of
+orion-orion/Distributed-Algorithm-PySpark: the PySpark RDD execution layer
+(parallelize / broadcast / treeAggregate / reduceByKey / join / shuffle) is
+replaced by a device-mesh runtime built on sharded ``jax.Array``s, ``shard_map``
+and XLA collectives over ICI/DCN, and the ten reference workloads (five
+data-parallel optimizers, k-means, PageRank, transitive closure, ALS, Monte
+Carlo) are re-implemented as whole-loop-compiled SPMD programs.
+
+Layer map (SURVEY.md §7):
+    parallel/  — mesh/runtime core + collectives/dataflow layer (replaces Spark)
+    ops/       — jittable numeric kernels (replaces the per-script NumPy lambdas)
+    models/    — workload entry points (replaces the reference's __main__ scripts)
+    utils/     — PRNG, datasets, metrics, plotting, checkpointing
+"""
+
+from tpu_distalg import ops, parallel, utils
+
+__version__ = "0.1.0"
+
+__all__ = ["ops", "parallel", "utils", "__version__"]

@@ -1,0 +1,36 @@
+"""Workload entry points — one per reference script (SURVEY.md §2.1).
+
+Each module exposes a config dataclass (same knob names as the reference's
+module-level globals, for traceability) and a ``train``/``run`` function
+whose whole iteration loop compiles to a single XLA program — the reference
+launches one Spark job per iteration (SURVEY.md §2.4); we launch one program
+per workload.
+"""
+
+from tpu_distalg.models import (
+    als,
+    bmuf,
+    easgd,
+    kmeans,
+    local_sgd,
+    logistic_regression,
+    ma,
+    monte_carlo,
+    pagerank,
+    ssgd,
+    transitive_closure,
+)
+
+__all__ = [
+    "als",
+    "bmuf",
+    "easgd",
+    "kmeans",
+    "local_sgd",
+    "logistic_regression",
+    "ma",
+    "monte_carlo",
+    "pagerank",
+    "ssgd",
+    "transitive_closure",
+]

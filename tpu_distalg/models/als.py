@@ -1,0 +1,112 @@
+"""ALS matrix factorization (the reference's "matrix decomposition").
+
+Re-design of ``/root/reference/matrix_computation/matrix_decomposition.py``:
+the reference broadcasts the FULL dense R, U, V to every task and solves one
+row per Spark task (``:46-48,52-62``) — SURVEY.md §2.3 calls this the one
+place the broadcast-everything design visibly fails to scale. Here R stays
+row-sharded over the mesh ``data`` axis permanently; each half-sweep is a
+batched normal-equation solve under GSPMD: the k×k Gram is computed once
+(the reference recomputes it in every task), the cross-shard contraction
+``Uᵀ·R`` is an XLA-inserted AllReduce over ICI, and factors carry sharding
+constraints so nothing dense is ever replicated needlessly.
+
+R's rows are zero-padded to the shard count; padded rows solve to exactly
+zero factor rows (zero RHS against a PD Gram), so they contribute nothing to
+Grams, RMSE numerator, or the V-update — the RMSE denominator uses the true
+m·n (``matrix_decomposition.py:19-21``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_distalg.ops import linalg
+from tpu_distalg.parallel import DATA_AXIS, pad_rows
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    """Knob names follow ``matrix_decomposition.py:12-17``."""
+
+    lam: float = 0.01
+    m: int = 100
+    n: int = 500
+    k: int = 10
+    n_iterations: int = 5
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class ALSResult:
+    U: jax.Array
+    V: jax.Array
+    rmse_history: jax.Array  # per-sweep RMSE
+
+    @property
+    def final_rmse(self) -> float:
+        return float(self.rmse_history[-1])
+
+
+def synthesize_rank_k(config: ALSConfig) -> np.ndarray:
+    """R = U₀·V₀ᵀ with U₀, V₀ ~ U[0,1) — the reference's synthetic
+    exactly-rank-k target (``matrix_decomposition.py:42``)."""
+    rng = np.random.default_rng(config.seed)
+    U0 = rng.random((config.m, config.k), dtype=np.float32)
+    V0 = rng.random((config.n, config.k), dtype=np.float32)
+    return U0 @ V0.T
+
+
+def make_fit_fn(mesh: Mesh, config: ALSConfig):
+    denom = config.m * config.n  # true element count, not padded
+    rows = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def fit(R, U0, V0):
+        def sweep(carry, _):
+            U, V = carry
+            # U-update: (VᵀV + λ·n·I) uᵢ = Vᵀ R[i,:]  (:52-54, :24-33)
+            G_v = linalg.gram(V, config.lam, config.n)
+            U = linalg.solve_factor_block(G_v, V, R)
+            U = lax.with_sharding_constraint(U, rows)
+            # V-update against Rᵀ: (UᵀU + λ·m·I) vⱼ = Uᵀ R[:,j]  (:60-62)
+            G_u = linalg.gram(U, config.lam, config.m)
+            V = linalg.solve_factor_block(G_u, U, R.T)
+            diff = R - U @ V.T  # padded rows are exactly zero on both sides
+            err = jnp.sqrt(jnp.sum(diff * diff) / denom)  # :19-21
+            return (U, V), err
+
+        (U, V), errs = jax.lax.scan(
+            sweep, (U0, V0), None, length=config.n_iterations
+        )
+        return U, V, errs
+
+    return jax.jit(fit)
+
+
+def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
+        R: np.ndarray | None = None) -> ALSResult:
+    if R is None:
+        R = synthesize_rank_k(config)
+    n_shards = mesh.shape[DATA_AXIS]
+    R_padded, _mask = pad_rows(np.asarray(R, dtype=np.float32), n_shards)
+
+    rng = np.random.default_rng(config.seed + 1)
+    # U0 is never read: the first half-sweep recomputes U from (V, R)
+    # exactly as the reference's first parallelize(range(m)) pass does
+    U0 = np.zeros((R_padded.shape[0], config.k), dtype=np.float32)
+    V0 = rng.random((config.n, config.k), dtype=np.float32)
+
+    rows = NamedSharding(mesh, P(DATA_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    R_dev = jax.device_put(jnp.asarray(R_padded), rows)
+    U_dev = jax.device_put(jnp.asarray(U0), rows)
+    V_dev = jax.device_put(jnp.asarray(V0), repl)
+
+    fn = make_fit_fn(mesh, config)
+    U, V, errs = fn(R_dev, U_dev, V_dev)
+    return ALSResult(U=U[: config.m], V=V, rmse_history=errs)

@@ -1,0 +1,32 @@
+"""BMUF — blockwise model update filtering.
+
+MA plus a block-level momentum filter on the averaged update:
+``delta_w = μ·delta_w + ζ·(w_avg − w); w += delta_w``
+(``/root/reference/optimization/bmuf.py:113-114``, μ=0.9 ζ=0.1 ``:24-25``).
+``delta_w`` starts *random* like the reference (``bmuf.py:95``) unless
+``random_delta_init=False``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from tpu_distalg.models import local_sgd
+from tpu_distalg.models.local_sgd import TrainResult
+
+
+@dataclasses.dataclass(frozen=True)
+class BMUFConfig(local_sgd.LocalSGDConfig):
+    n_iterations: int = 300
+    n_local_iterations: int = 5
+    global_update: str = "bmuf"
+    resync: bool = True
+    mu: float = 0.9
+    zeta: float = 0.1
+
+
+def train(X_train, y_train, X_test, y_test, mesh: Mesh,
+          config: BMUFConfig = BMUFConfig()) -> TrainResult:
+    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config)

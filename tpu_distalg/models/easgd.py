@@ -1,0 +1,41 @@
+"""EASGD — elastic averaging SGD.
+
+Local models are *not* resynced to the center; each round every replica
+takes one elastic step ``w_i ← w_i − η·ḡ − α(w_i − w)`` (``/root/reference/
+optimization/easgd.py:41-45``) with α = η·ρ (``:24``), and the center blends
+``w ← (1−β)·w + β·mean(w_i)`` with β = n_replicas·α (``:25,106``). β is
+derived from the actual mesh size at build time unless overridden.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from tpu_distalg.models import local_sgd
+from tpu_distalg.models.local_sgd import TrainResult
+
+_RHO = 0.1   # easgd.py:23
+_ETA = 0.1   # easgd.py:21
+
+
+@dataclasses.dataclass(frozen=True)
+class EASGDConfig(local_sgd.LocalSGDConfig):
+    n_iterations: int = 1500
+    n_local_iterations: int = 1   # one local step per round (easgd.py:95-104)
+    eta: float = _ETA
+    rho: float = _RHO
+    elastic_alpha: float | None = None  # None → derived α = η·ρ (easgd.py:24)
+    global_update: str = "easgd"
+    resync: bool = False
+    beta: float | None = None     # None → n_replicas · α at build time
+
+    def __post_init__(self):
+        if self.elastic_alpha is None:
+            object.__setattr__(self, "elastic_alpha", self.eta * self.rho)
+
+
+def train(X_train, y_train, X_test, y_test, mesh: Mesh,
+          config: EASGDConfig = EASGDConfig()) -> TrainResult:
+    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config)

@@ -1,0 +1,116 @@
+"""K-means (Lloyd's algorithm).
+
+Re-design of ``/root/reference/machine_learning/k-means.py``: the per-point
+``closest_center`` Python loop (``:20-28``) becomes a batched distance
+argmin on the MXU; the ``reduceByKey`` cluster statistics (``:62-63``)
+become a local ``segment_sum`` plus one psum of the (k, dim)+ (k,) stats
+across shards; the driver center update (``:70-71``) happens replicated
+on-device. The reference runs 5 fixed iterations and never uses its
+``convergeDist`` constant (``:16``, SURVEY.md §2.1 row 6) — we default to
+fixed iterations for parity and offer a real convergence check behind
+``converge_dist``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_distalg.ops import kmeans as kops
+from tpu_distalg.parallel import data_parallel, parallelize, tree_allreduce_sum
+
+
+@dataclasses.dataclass(frozen=True)
+class KMeansConfig:
+    """Knob names follow ``k-means.py:14-17``."""
+
+    k: int = 2
+    n_iterations: int = 5
+    converge_dist: float | None = None  # None → fixed iters (parity)
+    max_iterations: int = 1000          # safety cap in converge mode
+    seed: int = 42
+
+
+@dataclasses.dataclass
+class KMeansResult:
+    centers: jax.Array            # (k, dim)
+    assignments: jax.Array        # (n_padded,) final cluster per point
+    n_iterations_run: int
+
+
+def _local_stats(points, mask, centers):
+    assign = kops.assign_clusters(points, centers)
+    sums, counts = kops.cluster_stats(points, mask, assign, centers.shape[0])
+    sums, counts = tree_allreduce_sum((sums, counts))
+    return sums, counts, assign
+
+
+def init_centers(points: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Seeded k-point sample without replacement — ``takeSample(False, k,
+    42)`` (``k-means.py:53``)."""
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(points.shape[0], size=k, replace=False)
+    return np.asarray(points)[idx].astype(np.float32)
+
+
+def make_fit_fn(mesh: Mesh, config: KMeansConfig):
+    stats_fn = data_parallel(
+        _local_stats,
+        mesh,
+        in_specs=(P("data", None), P("data"), P()),
+        out_specs=(P(), P(), P("data")),
+    )
+
+    def one_iter(points, mask, centers):
+        sums, counts, assign = stats_fn(points, mask, centers)
+        return kops.update_centers(sums, counts, centers), assign
+
+    def fit(points, mask, centers0):
+        if config.converge_dist is None:
+            def body(centers, _):
+                centers, _assign = one_iter(points, mask, centers)
+                return centers, None
+
+            centers, _ = jax.lax.scan(
+                body, centers0, None, length=config.n_iterations
+            )
+            n_run = config.n_iterations
+        else:
+            def cond(state):
+                _, shift, it = state
+                return (shift > config.converge_dist) & (
+                    it < config.max_iterations
+                )
+
+            def body(state):
+                centers, _, it = state
+                new, _assign = one_iter(points, mask, centers)
+                shift = jnp.sum(
+                    jnp.sqrt(jnp.sum((new - centers) ** 2, axis=1))
+                )
+                return new, shift, it + 1
+
+            centers, _, n_run = jax.lax.while_loop(
+                cond, body, (centers0, jnp.float32(jnp.inf), 0)
+            )
+        # final assignment under the final centers (the reference's closing
+        # display re-evaluates with updated centers, k-means.py:57-58,76)
+        _, _, assign = stats_fn(points, mask, centers)
+        return centers, assign, n_run
+
+    return jax.jit(fit)
+
+
+def fit(points: np.ndarray, mesh: Mesh,
+        config: KMeansConfig = KMeansConfig()) -> KMeansResult:
+    ps = parallelize(points, mesh)
+    centers0 = init_centers(points, config.k, config.seed)
+    fn = make_fit_fn(mesh, config)
+    centers, assign, n_run = fn(ps.data, ps.mask, jnp.asarray(centers0))
+    return KMeansResult(
+        centers=centers, assignments=assign, n_iterations_run=int(n_run)
+    )

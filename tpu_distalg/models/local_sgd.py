@@ -1,0 +1,188 @@
+"""Shared local-update harness for the periodic-averaging optimizer family.
+
+MA (``/root/reference/optimization/ma.py``), BMUF (``bmuf.py``) and EASGD
+(``easgd.py``) share one machinery (SURVEY.md §2.1 rows 3-5): per-replica
+local models take minibatch-SGD steps on their own shard, then a global
+round combines them. The reference keeps per-replica models as a keyed RDD
+joined against sampled points (``ma.py:99-102``) and runs one Spark job per
+round; here each replica's local loop is a ``lax.scan`` *inside* a
+``shard_map`` body — local steps never touch the interconnect, and only the
+round-level combine is a collective, exactly mirroring the reference's
+job-per-round boundary (SURVEY.md §3.2).
+
+Semantics quirks reproduced behind flags (SURVEY.md §7 hard part #6):
+  * the reference reuses the SAME minibatch for all 5 local steps of a round
+    (seed ``42+t`` inside the local loop, ``ma.py:98-99``) — default;
+    ``resample_per_local_step=True`` gives each local step a fresh draw;
+  * BMUF's block-momentum ``delta_w`` is initialised *random*, not zero
+    (``bmuf.py:95``) — ``random_delta_init`` flag;
+  * EASGD does NOT resync local models to the center each round
+    (``easgd.py:95-106`` has no resync line, unlike ``ma.py:96``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_distalg.ops import logistic, sampling
+from tpu_distalg.parallel import DATA_AXIS, data_parallel, parallelize
+from tpu_distalg.utils import metrics, prng
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalSGDConfig:
+    """Knob names follow ``ma.py:19-23`` / ``bmuf.py:19-25`` /
+    ``easgd.py:19-25``."""
+
+    n_iterations: int = 300          # global rounds
+    n_local_iterations: int = 5      # local steps per round
+    eta: float = 0.1
+    mini_batch_fraction: float = 0.1
+    # round-level combine: 'average' (MA) | 'bmuf' | 'easgd'
+    global_update: str = "average"
+    resync: bool = True              # broadcast center to replicas each round
+    elastic_alpha: float = 0.0       # EASGD α = η·ρ (easgd.py:24)
+    mu: float = 0.9                  # BMUF momentum (bmuf.py:24)
+    zeta: float = 0.1                # BMUF block learning rate (bmuf.py:25)
+    beta: float | None = None        # EASGD center rate; None → n_replicas·α
+    resample_per_local_step: bool = False
+    random_delta_init: bool = True   # BMUF delta_w ~ U[-1,1) (bmuf.py:95)
+    seed: int = 42
+    init_seed: int = 7
+    eval_test: bool = True
+
+
+@dataclasses.dataclass
+class TrainResult:
+    w: jax.Array
+    ws: jax.Array  # final per-replica models (n_replicas, D)
+    accs: jax.Array
+
+    @property
+    def final_acc(self) -> float:
+        return float(self.accs[-1])
+
+
+def _make_local_rounds(config: LocalSGDConfig):
+    """shard_map body: resync (maybe), run L local steps on the local shard."""
+
+    def local_rounds(X, y, masks, ws_local, w):
+        # X (rows, D) local block; masks (L, rows); ws_local (1, D); w (D,)
+        w_l = w if config.resync else ws_local[0]
+
+        def local_step(w_l, mask):
+            g_sum, cnt = logistic.grad_sum(X, y, w_l, mask)
+            g_mean = g_sum / jnp.maximum(cnt, 1.0)  # update_local_w ma.py:39-43
+            w_l = (
+                w_l
+                - config.eta * g_mean
+                - config.elastic_alpha * (w_l - w)  # easgd.py:41-45
+            )
+            return w_l, None
+
+        w_l, _ = jax.lax.scan(local_step, w_l, masks)
+        return w_l[None, :]
+
+    return local_rounds
+
+
+def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
+    n_replicas = mesh.shape[DATA_AXIS]
+    beta = (
+        config.beta
+        if config.beta is not None
+        else n_replicas * config.elastic_alpha  # easgd.py:25
+    )
+    L = config.n_local_iterations
+    key = prng.root_key(config.seed)
+
+    local_fn = data_parallel(
+        _make_local_rounds(config),
+        mesh,
+        in_specs=(
+            P("data", None),   # X rows
+            P("data"),         # y
+            P(None, "data"),   # masks (L, rows)
+            P("data", None),   # per-replica models (R, D) → (1, D) local
+            P(),               # center w
+        ),
+        out_specs=P("data", None),
+    )
+
+    def round_masks(valid, t):
+        if config.resample_per_local_step:
+            draws = [
+                sampling.bernoulli_mask(
+                    key, t * L + l, n_padded,
+                    config.mini_batch_fraction, valid,
+                )
+                for l in range(L)
+            ]
+            return jnp.stack(draws)
+        # reference parity: one draw per round, reused by every local step
+        # (sample(False, frac, 42+t) inside the local loop, ma.py:98-99)
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid
+        )
+        return jnp.broadcast_to(mask, (L, n_padded))
+
+    def train(X, y, valid, X_test, y_test, w0, ws0, delta0):
+        def round_step(carry, t):
+            w, ws, delta = carry
+            masks = round_masks(valid, t)
+            ws = local_fn(X, y, masks, ws, w)
+            w_avg = jnp.mean(ws, axis=0)  # treeAggregate/n ma.py:104-106
+            if config.global_update == "average":
+                w = w_avg
+            elif config.global_update == "bmuf":
+                delta = config.mu * delta + config.zeta * (w_avg - w)
+                w = w + delta  # bmuf.py:113-114
+            elif config.global_update == "easgd":
+                w = (1 - beta) * w + beta * w_avg  # easgd.py:106
+            else:
+                raise ValueError(config.global_update)
+            acc = (
+                metrics.binary_accuracy(X_test @ w, y_test)
+                if config.eval_test
+                else jnp.float32(0)
+            )
+            return (w, ws, delta), acc
+
+        (w, ws, delta), accs = jax.lax.scan(
+            round_step, (w0, ws0, delta0), jnp.arange(config.n_iterations)
+        )
+        return w, ws, accs
+
+    return jax.jit(train)
+
+
+def train(
+    X_train, y_train, X_test, y_test, mesh: Mesh,
+    config: LocalSGDConfig = LocalSGDConfig(),
+) -> TrainResult:
+    Xs = parallelize(X_train, mesh)
+    ys = parallelize(y_train, mesh)
+    D = X_train.shape[1]
+    n_replicas = mesh.shape[DATA_AXIS]
+    k_init = prng.root_key(config.init_seed)
+    w0 = logistic.init_weights(jax.random.fold_in(k_init, 0), D)
+    # per-replica init ~ U[-1,1): ma.py:86 parallelize(2*ranf((n_slices,D+1))-1)
+    ws0 = jax.random.uniform(
+        jax.random.fold_in(k_init, 1), (n_replicas, D), minval=-1.0, maxval=1.0
+    )
+    if config.global_update == "bmuf" and config.random_delta_init:
+        delta0 = jax.random.uniform(
+            jax.random.fold_in(k_init, 2), (D,), minval=-1.0, maxval=1.0
+        )
+    else:
+        delta0 = jnp.zeros((D,))
+    fn = make_train_fn(mesh, config, Xs.n_padded)
+    w, ws, accs = fn(
+        Xs.data, ys.data, Xs.mask,
+        jnp.asarray(X_test), jnp.asarray(y_test), w0, ws0, delta0,
+    )
+    return TrainResult(w=w, ws=ws, accs=accs)

@@ -1,0 +1,28 @@
+"""MA — model averaging (local SGD / FedAvg-style).
+
+Thin preset over the shared local-update harness; semantics of
+``/root/reference/optimization/ma.py`` (300 rounds × 5 local steps, plain
+average combine, resync each round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from tpu_distalg.models import local_sgd
+from tpu_distalg.models.local_sgd import TrainResult
+
+
+@dataclasses.dataclass(frozen=True)
+class MAConfig(local_sgd.LocalSGDConfig):
+    n_iterations: int = 300
+    n_local_iterations: int = 5
+    global_update: str = "average"
+    resync: bool = True
+
+
+def train(X_train, y_train, X_test, y_test, mesh: Mesh,
+          config: MAConfig = MAConfig()) -> TrainResult:
+    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config)

@@ -1,0 +1,141 @@
+"""PageRank power iteration.
+
+Re-design of ``/root/reference/graph_computation/pagerank.py``: the
+join+flatMap+reduceByKey shuffle pipeline (``:50-57``) becomes an
+edge-parallel sweep — edges are sharded over the mesh data axis; each shard
+gathers ``ranks[src]``, scatters contributions into a dense rank vector via
+``segment_sum``, and one psum combines shards. Ten iterations compile into
+a single ``lax.scan``; the reference executes them as one 10-join-deep lazy
+lineage at collect time (SURVEY.md §3.4).
+
+Two modes (SURVEY.md §7 hard part #6):
+  * ``mode='reference'`` reproduces the reference's semantics exactly: n is
+    the number of vertices WITH out-links (``:41-44``), sink vertices keep
+    no rank and their mass vanishes (no dangling handling — ranks don't sum
+    to 1, see the recorded outputs ``:66-68``), and a vertex only holds a
+    rank in round t+1 if it received a contribution in round t.
+  * ``mode='standard'`` is textbook PageRank over all vertices with optional
+    dangling-mass redistribution — what you actually want at 1M nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_distalg.ops import graph as gops
+from tpu_distalg.parallel import DATA_AXIS, data_parallel, pad_rows, tree_allreduce_sum
+
+
+@dataclasses.dataclass(frozen=True)
+class PageRankConfig:
+    """Knob names follow ``pagerank.py:17-19``."""
+
+    n_iterations: int = 10
+    q: float = 0.15
+    mode: str = "reference"  # 'reference' | 'standard'
+    redistribute_dangling: bool = True  # standard mode only
+
+
+@dataclasses.dataclass
+class PageRankResult:
+    ranks: jax.Array      # (V,) dense rank vector
+    has_rank: jax.Array   # (V,) bool: vertex holds a rank (reference mode)
+
+
+def _local_sweep(src, dst, emask, ranks, inv_deg, has_rank, n_vertices):
+    """Per-shard contribution scatter + cross-shard combine."""
+    active = emask * has_rank[src]
+    per_edge = ranks[src] * inv_deg[src] * active
+    c = gops.scatter_add(per_edge, dst, n_vertices)
+    received = gops.scatter_add(active, dst, n_vertices)
+    return tree_allreduce_sum((c, received))
+
+
+def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
+    def body(src, dst, emask, ranks, inv_deg, has_rank):
+        return _local_sweep(
+            src, dst, emask, ranks, inv_deg, has_rank, n_vertices
+        )
+
+    sweep_fn = data_parallel(
+        body,
+        mesh,
+        in_specs=(P("data"), P("data"), P("data"), P(), P(), P()),
+        out_specs=(P(), P()),
+    )
+
+    def run(src, dst, emask, inv_deg, has_out, n_ref):
+        q = config.q
+        if config.mode == "reference":
+            ranks0 = jnp.where(has_out > 0, 1.0 / n_ref, 0.0)  # :47
+            has_rank0 = has_out
+
+            def step(carry, _):
+                ranks, has_rank = carry
+                c, received = sweep_fn(
+                    src, dst, emask, ranks, inv_deg, has_rank
+                )
+                new_has = (received > 0).astype(jnp.float32)
+                ranks = jnp.where(
+                    received > 0, q / n_ref + (1 - q) * c, 0.0
+                )  # :57
+                return (ranks, new_has), None
+
+            (ranks, has_rank), _ = jax.lax.scan(
+                step, (ranks0, has_rank0), None,
+                length=config.n_iterations,
+            )
+            return ranks, has_rank
+
+        # standard mode: every vertex ranked, Σranks preserved
+        V = n_vertices
+        ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+        all_ranked = jnp.ones((V,), dtype=jnp.float32)
+
+        def step(ranks, _):
+            c, _ = sweep_fn(src, dst, emask, ranks, inv_deg, all_ranked)
+            if config.redistribute_dangling:
+                dangling = jnp.sum(ranks * (1.0 - has_out))
+                c = c + dangling / V
+            ranks = q / V + (1 - q) * c
+            return ranks, None
+
+        ranks, _ = jax.lax.scan(
+            step, ranks0, None, length=config.n_iterations
+        )
+        return ranks, all_ranked
+
+    return jax.jit(run)
+
+
+def run(edges: np.ndarray, mesh: Mesh,
+        config: PageRankConfig = PageRankConfig(),
+        n_vertices: int | None = None) -> PageRankResult:
+    el = gops.prepare_edges(edges, n_vertices)
+    n_shards = mesh.shape[DATA_AXIS]
+    V = el.n_vertices
+
+    ev = np.stack([el.src, el.dst], axis=1)
+    ev_padded, emask = pad_rows(ev, n_shards)
+    from tpu_distalg.parallel import data_sharding
+    shard1 = data_sharding(mesh, 1)
+    src = jax.device_put(jnp.asarray(ev_padded[:, 0]), shard1)
+    dst = jax.device_put(jnp.asarray(ev_padded[:, 1]), shard1)
+    emask_d = jax.device_put(jnp.asarray(emask), shard1)
+
+    deg = el.out_degree.astype(np.float32)
+    inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1.0), 0.0)
+    has_out = (deg > 0).astype(np.float32)
+    n_ref = float(has_out.sum())  # n_vertexes = count with out-links (:41-44)
+
+    fn = make_run_fn(mesh, config, V)
+    ranks, has_rank = fn(
+        src, dst, emask_d,
+        jnp.asarray(inv_deg), jnp.asarray(has_out), n_ref,
+    )
+    return PageRankResult(ranks=ranks, has_rank=has_rank)

@@ -1,0 +1,77 @@
+"""Transitive closure by fixpoint iteration.
+
+Re-design of ``/root/reference/graph_computation/transitive_closure.py``:
+the reference joins the full path set against reversed edges, unions, dedups
+and counts every round until the count stops growing (``:27-40``) — a
+shuffle-heavy O(rounds) Spark pipeline with dynamic-size sets. Dynamic set
+semantics don't exist under XLA's static shapes (SURVEY.md §7 hard part #3),
+so the path set is a dense boolean V×V matrix: one round is a boolean
+matmul on the MXU (edge ∘ path composition) + logical-or union, the
+``distinct`` is free (idempotent |), and the fixpoint test compares popcounts
+inside ``lax.while_loop`` — matching the reference's count-based convergence
+(``:38-40``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_distalg.ops import graph as gops
+from tpu_distalg.parallel import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ClosureConfig:
+    max_iterations: int | None = None  # None → V (always enough)
+
+
+@dataclasses.dataclass
+class ClosureResult:
+    paths: jax.Array  # (V, V) bool reachability
+    n_paths: int      # the reference's final paths.count() (:42)
+    n_rounds: int
+
+
+def run(edges: np.ndarray, mesh: Mesh,
+        config: ClosureConfig = ClosureConfig(),
+        n_vertices: int | None = None) -> ClosureResult:
+    el = gops.prepare_edges(edges, n_vertices)
+    n_shards = mesh.shape[DATA_AXIS]
+    # pad vertex count so path-matrix rows shard evenly; padded vertices are
+    # isolated (no edges) and add no paths
+    V = -(-el.n_vertices // n_shards) * n_shards
+    cap = config.max_iterations if config.max_iterations is not None else V + 1
+
+    adj = np.zeros((V, V), dtype=bool)
+    adj[el.src, el.dst] = True
+    rows = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    @jax.jit
+    def fixpoint(edges_bool):
+        paths0 = edges_bool  # paths start as the edge set (:18-27)
+        cnt0 = gops.path_count(paths0)
+
+        def cond(state):
+            _, old_cnt, cnt, it = state
+            return (cnt != old_cnt) & (it < cap)
+
+        def body(state):
+            paths, _, cnt, it = state
+            new_paths = gops.closure_step(paths, edges_bool)
+            new_paths = lax.with_sharding_constraint(new_paths, rows)
+            return new_paths, cnt, gops.path_count(new_paths), it + 1
+
+        return lax.while_loop(
+            cond, body, (paths0, jnp.int32(-1), cnt0, jnp.int32(0))
+        )
+
+    paths, _, cnt, rounds = fixpoint(jnp.asarray(adj))
+    return ClosureResult(
+        paths=paths, n_paths=int(cnt), n_rounds=int(rounds)
+    )

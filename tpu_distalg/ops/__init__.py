@@ -1,0 +1,11 @@
+"""Jittable numeric kernels — the build's layer C (SURVEY.md §7).
+
+Replaces the inline NumPy lambdas each reference script ships to executors
+(``logistic_f`` / ``gradient`` / ``closest_center`` / ALS ``update`` /
+``computeContribs``) with vmapped, mask-aware, numerically-stable JAX
+kernels that XLA fuses onto the MXU/VPU.
+"""
+
+from tpu_distalg.ops import graph, kmeans, linalg, logistic, sampling
+
+__all__ = ["graph", "kmeans", "linalg", "logistic", "sampling"]

@@ -1,0 +1,92 @@
+"""Graph kernels: edge-parallel PageRank pieces and boolean closure steps.
+
+Replaces the reference's shuffle-based graph pipeline — ``distinct().
+groupByKey()`` adjacency build (``/root/reference/graph_computation/
+pagerank.py:41``), ``join``+``flatMap`` contribution scatter (``:52-54``) and
+``reduceByKey(add)`` (``:57``) — with static-shape index arrays (SURVEY.md §7
+hard part #3): the graph is a deduplicated (src, dst) edge list; a PageRank
+sweep is a gather (``ranks[src]``) followed by a ``segment_sum`` scatter-add
+into the rank vector; cross-shard combination is one psum of the dense
+vector. Transitive closure is a boolean-matmul fixpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeList:
+    """Deduplicated static-shape graph: the adjacency-list replacement."""
+
+    src: np.ndarray  # (E,) int32
+    dst: np.ndarray  # (E,) int32
+    n_vertices: int
+    out_degree: np.ndarray  # (V,) int32
+
+    @property
+    def n_edges(self) -> int:
+        return len(self.src)
+
+
+def prepare_edges(edges: np.ndarray, n_vertices: int | None = None) -> EdgeList:
+    """Dedupe an (E, 2) edge array and precompute out-degrees.
+
+    Host-side preprocessing standing in for ``links.distinct()`` +
+    ``groupByKey`` (``pagerank.py:41``): set semantics once, up front,
+    instead of a shuffle per run.
+    """
+    edges = np.asarray(edges)
+    edges = np.unique(edges, axis=0)  # distinct
+    src, dst = edges[:, 0], edges[:, 1]
+    if n_vertices is None:
+        n_vertices = int(edges.max()) + 1 if len(edges) else 0
+    out_degree = np.bincount(src, minlength=n_vertices)
+    return EdgeList(
+        src=src.astype(np.int32),
+        dst=dst.astype(np.int32),
+        n_vertices=n_vertices,
+        out_degree=out_degree.astype(np.int32),
+    )
+
+
+def scatter_add(values: jax.Array, dst: jax.Array, n: int) -> jax.Array:
+    """``reduceByKey(add)`` over dense vertex ids: one XLA scatter-add."""
+    return jax.ops.segment_sum(values, dst, num_segments=n)
+
+
+def contribs(
+    ranks: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    inv_out_degree: jax.Array,
+    edge_mask: jax.Array,
+    n: int,
+) -> jax.Array:
+    """Per-edge contribution rank[src]/deg[src] scattered onto dst —
+    ``computeContribs`` + ``reduceByKey`` (``pagerank.py:21-25,57``) fused
+    into gather → multiply → segment_sum."""
+    per_edge = ranks[src] * inv_out_degree[src] * edge_mask
+    return scatter_add(per_edge, dst, n)
+
+
+def closure_step(paths: jax.Array, edges_bool: jax.Array) -> jax.Array:
+    """One linear-closure round: new (x,z) ≙ edge (x,y) ∘ path (y,z), then
+    union — the reference's join-with-reversed-edges + union + distinct
+    (``transitive_closure.py:33-37``) as a boolean matmul + logical-or.
+
+    Boolean matmul rides the MXU as a float matmul > 0 test.
+    """
+    composed = (
+        edges_bool.astype(jnp.float32) @ paths.astype(jnp.float32)
+    ) > 0.0
+    return paths | composed
+
+
+def path_count(paths: jax.Array) -> jax.Array:
+    """``paths.count()`` (``transitive_closure.py:38``)."""
+    return jnp.sum(paths.astype(jnp.int32))

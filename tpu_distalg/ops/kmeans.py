@@ -1,0 +1,47 @@
+"""Lloyd's-algorithm kernels.
+
+Replaces the reference's per-point Python loop ``closest_center``
+(``/root/reference/machine_learning/k-means.py:20-28``) and its
+``reduceByKey`` cluster statistics (``k-means.py:62-63``) with a batched
+distance argmin and a ``segment_sum`` scatter-reduction — the keyed shuffle
+becomes an XLA scatter-add plus (cross-shard) psum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def assign_clusters(points: jax.Array, centers: jax.Array) -> jax.Array:
+    """Index of the nearest center per point (squared-Euclidean argmin;
+    first-minimum tie-break matches the reference's strict ``<`` scan)."""
+    # (n, k) distance matrix via the expansion trick — one MXU matmul.
+    d2 = (
+        jnp.sum(points * points, axis=1, keepdims=True)
+        - 2.0 * points @ centers.T
+        + jnp.sum(centers * centers, axis=1)[None, :]
+    )
+    return jnp.argmin(d2, axis=1)
+
+
+def cluster_stats(
+    points: jax.Array, mask: jax.Array, assign: jax.Array, k: int
+):
+    """(Σ points, count) per cluster — the reference's reduceByKey pair
+    ``(p1+p2, cnt1+cnt2)`` (``k-means.py:60-63``) as one segment_sum."""
+    weighted = points * mask[:, None]
+    sums = jax.ops.segment_sum(weighted, assign, num_segments=k)
+    counts = jax.ops.segment_sum(mask, assign, num_segments=k)
+    return sums, counts
+
+
+def update_centers(
+    sums: jax.Array, counts: jax.Array, old_centers: jax.Array
+) -> jax.Array:
+    """Mean per cluster; empty clusters keep their old center (the reference
+    only overwrites ``k_centers[c_id]`` for ids present in the collect,
+    ``k-means.py:66-71``)."""
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    means = sums / safe
+    return jnp.where(counts[:, None] > 0, means, old_centers)

@@ -1,0 +1,57 @@
+"""Logistic-regression kernels.
+
+Replaces the reference's closure-shipped NumPy functions ``logistic_f`` and
+``gradient`` (``/root/reference/optimization/ssgd.py:23-33``) with
+numerically-stable, mask-aware batched kernels. Differences by design:
+
+  * Stable sigmoid (``jax.nn.sigmoid``) instead of ``1/(exp(-z)+1)`` —
+    the reference overflows for large negative margins and papers over it
+    with a ``+1e-6`` denominator in the local-SGD scripts (``ma.py:26``);
+    SURVEY.md §5 flags this as a real NaN hazard we must not replicate.
+  * Whole-shard matrix form: per-point gradients are never materialised;
+    the (D+1,)-vector gradient sum is one fused matvec on the MXU,
+    ``Xᵀ·(σ(Xw) − y)·mask``, instead of a Python map + tree reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def predict_proba(X: jax.Array, w: jax.Array) -> jax.Array:
+    """σ(X·w) — stable equivalent of ``logistic_f`` (``ssgd.py:23-24``)."""
+    return jax.nn.sigmoid(X @ w)
+
+
+def grad_sum(
+    X: jax.Array, y: jax.Array, w: jax.Array, mask: jax.Array
+):
+    """Masked gradient sum and sample count.
+
+    Per-point gradient is ``-(y − σ(x·w))·x`` (``ssgd.py:27-33``); summing
+    over the masked rows gives exactly the reference's treeAggregate pair
+    ``(Σ grad, count)`` (``ssgd.py:99-103``) for one shard.
+    """
+    residual = (predict_proba(X, w) - y) * mask
+    return X.T @ residual, jnp.sum(mask)
+
+
+def reg_gradient(w: jax.Array, reg_type: str = "l2", alpha: float = 0.0):
+    """Regulariser gradient, matching ``reg_gradient`` (``ssgd.py:36-47``):
+    l2 → w, l1 → sign(w), elastic_net → α·sign(w) + (1−α)·w."""
+    if reg_type == "none":
+        return jnp.zeros_like(w)
+    if reg_type == "l2":
+        return w
+    if reg_type == "l1":
+        return jnp.sign(w)
+    if reg_type == "elastic_net":
+        return alpha * jnp.sign(w) + (1 - alpha) * w
+    raise ValueError(f"unknown reg_type {reg_type!r}")
+
+
+def init_weights(key: jax.Array, dim: int) -> jax.Array:
+    """Uniform in [-1, 1) — the reference's ``2*ranf(D+1) − 1`` init
+    (``ssgd.py:89``)."""
+    return jax.random.uniform(key, (dim,), minval=-1.0, maxval=1.0)

@@ -1,0 +1,49 @@
+"""Mesh/runtime core and the collectives/dataflow layer.
+
+This package is the Spark replacement (SURVEY.md §2.2): everything the
+reference scripts obtained from ``spark.sparkContext`` — RDD creation,
+broadcast, tree aggregation, keyed reduction, per-partition compute — has a
+TPU-native equivalent here, built on ``jax.sharding`` meshes, ``shard_map``
+and XLA collectives.
+"""
+
+from tpu_distalg.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshContext,
+    get_mesh,
+    local_device_count,
+)
+from tpu_distalg.parallel.sharding import (
+    ShardedMatrix,
+    data_sharding,
+    pad_rows,
+    parallelize,
+    replicate,
+    replicated_sharding,
+)
+from tpu_distalg.parallel.collectives import (
+    tree_allreduce_mean,
+    tree_allreduce_sum,
+    ring_shift,
+)
+from tpu_distalg.parallel.spmd import data_parallel, replica_index
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "MeshContext",
+    "ShardedMatrix",
+    "data_parallel",
+    "data_sharding",
+    "get_mesh",
+    "local_device_count",
+    "pad_rows",
+    "parallelize",
+    "replica_index",
+    "replicate",
+    "replicated_sharding",
+    "ring_shift",
+    "tree_allreduce_mean",
+    "tree_allreduce_sum",
+]

@@ -1,0 +1,112 @@
+"""Device-mesh runtime core.
+
+Replaces the reference's ``SparkSession.builder...getOrCreate()`` + executor
+topology (e.g. ``/root/reference/optimization/ssgd.py:78-81`` and the
+``n_slices`` partition-count globals) with a ``jax.sharding.Mesh`` over the
+available TPU chips. Where Spark runs ``local[*]`` threads as fake executors
+for single-machine testing (SURVEY.md §4), we run N virtual CPU devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+
+Two mesh axes by default:
+  * ``data``  — data parallelism: rows of an RDD-like array live here.
+  * ``model`` — model parallelism: factor matrices / feature blocks can be
+    sharded here (used by the ALS workload; size 1 by default).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def emulate_devices(n: int = 8, platform: str = "cpu") -> None:
+    """Request ``n`` virtual host devices. Must run before JAX is initialised.
+
+    The JAX analogue of Spark ``local[*]`` (no master URL set anywhere in the
+    reference, e.g. ``/root/reference/optimization/ssgd.py:78-81``).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", platform)
+    # env vars alone lose to site plugins that force another platform via
+    # jax.config; the config update wins when no backend is initialised yet
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", platform)
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def multihost_initialize(**kwargs) -> None:
+    """Initialise the multi-host runtime (DCN-connected TPU slices).
+
+    Thin wrapper over ``jax.distributed.initialize`` so workloads never import
+    it directly; a no-op when running single-process (the common test path).
+    """
+    if jax.process_count() > 1 or kwargs:
+        jax.distributed.initialize(**kwargs)
+
+
+def get_mesh(
+    data: int | None = None,
+    model: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 2-D ``(data, model)`` mesh.
+
+    ``data=None`` uses every available device on the data axis (after
+    dividing out ``model``). This is the stand-in for the per-script
+    ``n_slices`` globals (``ssgd.py:17``): partition count == mesh data size.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    need = data * model
+    if need > n:
+        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {n}")
+    grid = np.array(devs[:need]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshContext:
+    """A mesh plus the axis names workloads shard over.
+
+    The one runtime object workloads receive — the role SparkSession plays in
+    every reference script.
+    """
+
+    mesh: Mesh
+
+    @classmethod
+    def create(cls, data: int | None = None, model: int = 1) -> "MeshContext":
+        return cls(mesh=get_mesh(data=data, model=model))
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape[MODEL_AXIS]
+
+    @property
+    def axis_sizes(self) -> Mapping[str, int]:
+        return dict(self.mesh.shape)

@@ -1,0 +1,5 @@
+"""PRNG plumbing, datasets, metrics, plotting, checkpointing."""
+
+from tpu_distalg.utils import datasets, metrics, prng
+
+__all__ = ["datasets", "metrics", "prng"]

@@ -1,0 +1,89 @@
+"""Dataset loading and synthesis.
+
+The reference's optimizers all train on sklearn breast-cancer with a fixed
+70/30 split (``/root/reference/optimization/ssgd.py:71-76``); benchmarks
+need synthetic data at scale (BASELINE.json: 1B-row two-class LR data,
+1M-node Erdős–Rényi graphs). Bias handling follows the reference: a ones
+column is appended to X (``ssgd.py:83-84``), so the model has D+1 weights.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def breast_cancer_split(test_size: float = 0.3, random_state: int = 0):
+    """Breast-cancer 70/30 split, bias column appended — the reference task.
+
+    Returns (X_train1, y_train, X_test1, y_test) with the ones column already
+    concatenated (matching ``ssgd.py:83-84``; test side ``ssgd.py:108-109``).
+    """
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=test_size, random_state=random_state, shuffle=True
+    )
+    return (
+        add_bias_column(X_train),
+        y_train.astype(np.float32),
+        add_bias_column(X_test),
+        y_test.astype(np.float32),
+    )
+
+
+def add_bias_column(X: np.ndarray) -> np.ndarray:
+    return np.concatenate(
+        [X, np.ones((X.shape[0], 1))], axis=1
+    ).astype(np.float32)
+
+
+def synthetic_two_class(
+    n_rows: int, n_features: int = 30, seed: int = 0, separation: float = 2.0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Linearly-separable-ish two-class Gaussian data for LR benchmarks."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(n_features,))
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    logits = X @ w_true * separation / np.sqrt(n_features)
+    y = (logits + rng.logistic(size=n_rows) > 0).astype(np.float32)
+    return X, y
+
+
+def gaussian_mixture(
+    n_rows: int, k: int = 4, dim: int = 2, seed: int = 0, spread: float = 8.0
+) -> np.ndarray:
+    """Gaussian-mixture points for k-means benchmarks (BASELINE.json config)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, dim)) * spread
+    assign = rng.integers(0, k, size=n_rows)
+    return (centers[assign] + rng.normal(size=(n_rows, dim))).astype(np.float32)
+
+
+def erdos_renyi_edges(
+    n_vertices: int, avg_degree: float = 8.0, seed: int = 0
+) -> np.ndarray:
+    """Uniform-random directed edge list (src, dst), shape (E, 2), no
+    self-loops — the 1M-node PageRank benchmark graph (BASELINE.json)."""
+    rng = np.random.default_rng(seed)
+    n_edges = int(n_vertices * avg_degree)
+    src = rng.integers(0, n_vertices, size=n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_vertices - 1, size=n_edges, dtype=np.int64)
+    dst = np.where(dst >= src, dst + 1, dst)  # avoid self-loops
+    return np.stack([src, dst], axis=1)
+
+
+def toy_graph_edges() -> np.ndarray:
+    """The reference's 4-edge toy graph (``pagerank.py:35-38``,
+    ``transitive_closure.py:18``), 0-indexed."""
+    return np.array([[0, 1], [0, 2], [1, 2], [2, 0]], dtype=np.int64)
+
+
+def toy_kmeans_matrix() -> np.ndarray:
+    """The reference's hard-coded 6x2 k-means input (``k-means.py:49-50``)."""
+    return np.array(
+        [[1, 2], [1, 4], [1, 0], [10, 2], [10, 4], [10, 0]], dtype=np.float32
+    )

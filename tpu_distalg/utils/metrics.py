@@ -1,0 +1,76 @@
+"""Metrics: accuracy, EWMA smoothing, and convergence-plot rendering.
+
+Reproduces the reference's observability surface — per-iteration test
+accuracy and the EWMA accuracy plot (``/root/reference/optimization/
+ssgd.py:50-66`` ``draw_acc_plot``, α=0.9) — plus step-timing helpers the
+reference lacks (SURVEY.md §5: build adds steps/sec metric emission).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def binary_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Accuracy with the reference's decision rule: predict 1 iff p >= 0.5
+    (``ssgd.py:110`` uses ``where(y_pred < 0.5, 0, 1)``)."""
+    pred = jnp.where(logits < 0.0, 0.0, 1.0)  # sigmoid(z) < .5  <=>  z < 0
+    return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+def ewma(values: np.ndarray, alpha: float = 0.9) -> np.ndarray:
+    """EWMA with the reference's recurrence s[t] = α·s[t-1] + (1-α)·v[t],
+    s[0] = v[0] (``ssgd.py:51-59``)."""
+    values = np.asarray(values, dtype=np.float64)
+    out = np.empty_like(values)
+    if len(values) == 0:
+        return out
+    out[0] = values[0]
+    for i in range(1, len(values)):
+        out[i] = alpha * out[i - 1] + (1 - alpha) * values[i]
+    return out
+
+
+def draw_acc_plot(accs, path: str, alpha: float = 0.9, title: str =
+                  "Accuracy on test dataset") -> None:
+    """Raw + EWMA accuracy curves, saved to ``path`` (≙ ``draw_acc_plot``)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    accs = np.asarray(accs)
+    xs = np.arange(1, len(accs) + 1)
+    fig, ax = plt.subplots()
+    ax.plot(xs, accs, color="C0", alpha=0.3)
+    ax.plot(xs, ewma(accs, alpha), color="C0")
+    ax.set_title(title)
+    ax.set_xlabel("Round")
+    ax.set_ylabel("Accuracy")
+    fig.savefig(path)
+    plt.close(fig)
+
+
+class StepTimer:
+    """Wall-clock timer that blocks on device completion — the honest way to
+    time XLA programs (dispatch is async)."""
+
+    def __init__(self):
+        self._t0 = None
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False
+
+    @staticmethod
+    def block(tree):
+        jax.block_until_ready(tree)

@@ -1,0 +1,23 @@
+"""Counter-based PRNG plumbing.
+
+Replaces the reference's per-iteration reseeding idiom ``sample(False, frac,
+42 + t)`` (``/root/reference/optimization/ssgd.py:97``) and its *unseeded*
+``random()`` in Monte Carlo (``randomized_algorithm/monte_carlo.py:18-19``)
+with deterministic ``jax.random`` key folding. With JAX's partitionable
+threefry, random bits depend only on (key, position) — so sampling decisions
+are identical regardless of how many devices the array is sharded over,
+which is what makes the n-device ≡ 1-device property tests possible.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int = 42) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def step_key(key: jax.Array, t) -> jax.Array:
+    """Key for iteration t (≙ the reference's ``seed=42 + t``)."""
+    return jax.random.fold_in(key, t)
