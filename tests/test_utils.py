@@ -1,0 +1,97 @@
+"""Tests for checkpointing, metrics/EWMA, plotting, and the CLI surface."""
+
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_distalg.utils import checkpoint, metrics
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tree = {"w": np.arange(5, dtype=np.float32),
+            "opt": {"m": np.ones((2, 2)), "step": np.int32(7)}}
+    d = str(tmp_path / "ckpt")
+    checkpoint.save(d, tree, step=10)
+    checkpoint.save(d, tree, step=20)
+    restored, step = checkpoint.restore(d)
+    assert step == 20
+    np.testing.assert_array_equal(restored["w"], tree["w"])
+    np.testing.assert_array_equal(restored["opt"]["m"], tree["opt"]["m"])
+    restored10, _ = checkpoint.restore(d, step=10)
+    np.testing.assert_array_equal(restored10["w"], tree["w"])
+
+
+def test_checkpoint_prune(tmp_path):
+    d = str(tmp_path / "ckpt")
+    for s in (1, 2, 3, 4, 5):
+        checkpoint.save(d, {"x": np.zeros(1)}, step=s)
+    checkpoint.prune(d, keep=2)
+    assert checkpoint.latest_step(d) == 5
+    _, s = checkpoint.restore(d)
+    assert s == 5
+    try:
+        checkpoint.restore(d, step=1)
+        assert False, "pruned step should be gone"
+    except FileNotFoundError:
+        pass
+
+
+def test_ewma_matches_reference_recurrence():
+    """s[0]=v[0]; s[t]=0.9*s[t-1]+0.1*v[t] (ssgd.py:51-59)."""
+    v = np.array([1.0, 0.0, 0.0])
+    s = metrics.ewma(v, alpha=0.9)
+    np.testing.assert_allclose(s, [1.0, 0.9, 0.81])
+
+
+def test_binary_accuracy_decision_rule():
+    """p >= 0.5 → 1 (ssgd.py:110): logit 0 counts as class 1."""
+    logits = jnp.array([-1.0, 0.0, 1.0])
+    labels = jnp.array([0.0, 1.0, 1.0])
+    assert float(metrics.binary_accuracy(logits, labels)) == 1.0
+
+
+def test_draw_acc_plot(tmp_path):
+    path = str(tmp_path / "acc.png")
+    metrics.draw_acc_plot(np.linspace(0.5, 0.9, 50), path)
+    import os
+
+    assert os.path.getsize(path) > 1000
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_distalg.cli", "--emulate", "4", *argv],
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+def test_cli_kmeans_toy():
+    r = _run_cli("kmeans")
+    assert r.returncode == 0, r.stderr
+    assert "Final centers" in r.stdout
+
+
+def test_cli_pagerank_toy():
+    r = _run_cli("pagerank")
+    assert r.returncode == 0, r.stderr
+    assert "0.38891" in r.stdout
+
+
+def test_cli_mc():
+    r = _run_cli("mc", "--n", "100000")
+    assert r.returncode == 0, r.stderr
+    assert "Pi is roughly 3.1" in r.stdout
+
+
+def test_cli_ssgd_short(tmp_path):
+    plot = str(tmp_path / "p.png")
+    r = _run_cli("ssgd", "--n-iterations", "50", "--quiet",
+                 "--plot", plot)
+    assert r.returncode == 0, r.stderr
+    assert "Final acc:" in r.stdout
+    import os
+
+    assert os.path.exists(plot)

@@ -1,0 +1,220 @@
+"""Command-line entry points — one subcommand per reference script.
+
+The reference exposes its workloads as ``python <script>.py`` with
+module-global knobs edited by hand (SURVEY.md §5 config); here every knob
+is a CLI flag with the same name and default, e.g.::
+
+    python -m tpu_distalg.cli ssgd --n-iterations 1500 --eta 0.1 \
+        --mini-batch-fraction 0.1 --plot ssgd_acc_plot.png
+
+Run ``--emulate N`` to execute on N virtual CPU devices (Spark
+``local[*]``-style) when no TPU is attached.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _mesh(args):
+    import jax
+
+    from tpu_distalg.parallel import get_mesh
+
+    return get_mesh(data=args.n_slices if args.n_slices > 0 else None)
+
+
+def _add_common(p, n_iterations, eta=None, frac=None):
+    p.add_argument("--n-slices", type=int, default=0,
+                   help="data-axis size; 0 = all devices")
+    p.add_argument("--n-iterations", type=int, default=n_iterations)
+    if eta is not None:
+        p.add_argument("--eta", type=float, default=eta)
+    if frac is not None:
+        p.add_argument("--mini-batch-fraction", type=float, default=frac)
+    p.add_argument("--plot", type=str, default=None,
+                   help="save an accuracy plot PNG here")
+    p.add_argument("--quiet", action="store_true")
+
+
+def _report_optimizer(name, res, args, t):
+    from tpu_distalg.utils import metrics
+
+    if not args.quiet:
+        print(f"Final w: {list(map(float, res.w))}")
+    print(f"Final acc: {res.final_acc:.6f}")
+    print(f"[{name}] {args.n_iterations} iterations in {t:.3f}s "
+          f"({args.n_iterations / t:.1f} steps/s)")
+    if args.plot:
+        metrics.draw_acc_plot(res.accs, args.plot)
+        print(f"saved plot: {args.plot}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="tpu_distalg")
+    parser.add_argument("--emulate", type=int, default=0, metavar="N",
+                        help="run on N virtual CPU devices")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("lr", help="full-batch logistic regression")
+    _add_common(p, 1500, eta=0.1)
+
+    p = sub.add_parser("ssgd", help="synchronous minibatch SGD")
+    _add_common(p, 1500, eta=0.1, frac=0.1)
+    p.add_argument("--lam", type=float, default=0.0)
+    p.add_argument("--reg-type", default="l2",
+                   choices=["none", "l2", "l1", "elastic_net"])
+
+    for name in ("ma", "bmuf", "easgd"):
+        p = sub.add_parser(name)
+        _add_common(p, 1500 if name == "easgd" else 300, eta=0.1, frac=0.1)
+        p.add_argument("--n-local-iterations", type=int,
+                       default=1 if name == "easgd" else 5)
+        p.add_argument("--resample-per-local-step", action="store_true")
+
+    p = sub.add_parser("kmeans")
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--n-iterations", type=int, default=5)
+    p.add_argument("--converge-dist", type=float, default=None)
+    p.add_argument("--n-points", type=int, default=0,
+                   help="0 = the reference's toy 6x2 matrix; else a "
+                        "Gaussian mixture of this many points")
+
+    p = sub.add_parser("pagerank")
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--n-iterations", type=int, default=10)
+    p.add_argument("--q", type=float, default=0.15)
+    p.add_argument("--mode", default="reference",
+                   choices=["reference", "standard"])
+    p.add_argument("--n-vertices", type=int, default=0,
+                   help="0 = the reference's 4-edge toy graph; else an "
+                        "Erdős–Rényi graph of this many vertices")
+
+    p = sub.add_parser("closure", help="transitive closure")
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--n-vertices", type=int, default=0)
+
+    p = sub.add_parser("als", help="ALS matrix decomposition")
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--m", type=int, default=100)
+    p.add_argument("--n", type=int, default=500)
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--lam", type=float, default=0.01)
+    p.add_argument("--n-iterations", type=int, default=5)
+
+    p = sub.add_parser("mc", help="Monte-Carlo pi")
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--n", type=int, default=400_000)
+
+    args = parser.parse_args(argv)
+
+    if args.emulate:
+        from tpu_distalg.parallel.mesh import emulate_devices
+
+        emulate_devices(args.emulate)
+
+    import jax  # after emulation setup
+
+    if args.cmd in ("lr", "ssgd", "ma", "bmuf", "easgd"):
+        from tpu_distalg.utils import datasets
+
+        data = datasets.breast_cancer_split()
+        mesh = _mesh(args)
+        t0 = time.perf_counter()
+        if args.cmd == "lr":
+            from tpu_distalg.models import logistic_regression as m
+
+            res = m.train(*data, mesh, m.LRConfig(
+                n_iterations=args.n_iterations, eta=args.eta))
+        elif args.cmd == "ssgd":
+            from tpu_distalg.models import ssgd as m
+
+            res = m.train(*data, mesh, m.SSGDConfig(
+                n_iterations=args.n_iterations, eta=args.eta,
+                mini_batch_fraction=args.mini_batch_fraction,
+                lam=args.lam, reg_type=args.reg_type))
+        else:
+            mod = {
+                "ma": "MAConfig", "bmuf": "BMUFConfig", "easgd": "EASGDConfig"
+            }
+            import importlib
+
+            m = importlib.import_module(f"tpu_distalg.models.{args.cmd}")
+            cfg_cls = getattr(m, mod[args.cmd])
+            res = m.train(*data, mesh, cfg_cls(
+                n_iterations=args.n_iterations, eta=args.eta,
+                mini_batch_fraction=args.mini_batch_fraction,
+                n_local_iterations=args.n_local_iterations,
+                resample_per_local_step=args.resample_per_local_step))
+        jax.block_until_ready(res.w)
+        _report_optimizer(args.cmd, res, args, time.perf_counter() - t0)
+
+    elif args.cmd == "kmeans":
+        from tpu_distalg.models import kmeans as m
+        from tpu_distalg.utils import datasets
+
+        pts = (datasets.toy_kmeans_matrix() if args.n_points == 0
+               else datasets.gaussian_mixture(args.n_points, k=args.k))
+        res = m.fit(pts, _mesh(args), m.KMeansConfig(
+            k=args.k, n_iterations=args.n_iterations,
+            converge_dist=args.converge_dist))
+        print(f"Final centers: {res.centers.tolist()}")
+        print(f"iterations run: {res.n_iterations_run}")
+
+    elif args.cmd == "pagerank":
+        from tpu_distalg.models import pagerank as m
+        from tpu_distalg.utils import datasets
+
+        edges = (datasets.toy_graph_edges() if args.n_vertices == 0
+                 else datasets.erdos_renyi_edges(args.n_vertices))
+        t0 = time.perf_counter()
+        res = m.run(edges, _mesh(args), m.PageRankConfig(
+            n_iterations=args.n_iterations, q=args.q, mode=args.mode))
+        jax.block_until_ready(res.ranks)
+        dt = time.perf_counter() - t0
+        import numpy as np
+
+        ranks = np.asarray(res.ranks)
+        mask = np.asarray(res.has_rank) > 0
+        shown = np.argsort(-ranks)[:10]
+        for v in shown:
+            if mask[v]:
+                print(f"{v} has rank: {ranks[v]}.")
+        print(f"[pagerank] {args.n_iterations} iterations in {dt:.3f}s "
+              f"({args.n_iterations / dt:.2f} iter/s)")
+
+    elif args.cmd == "closure":
+        from tpu_distalg.models import transitive_closure as m
+        from tpu_distalg.utils import datasets
+
+        edges = (datasets.toy_graph_edges() if args.n_vertices == 0
+                 else datasets.erdos_renyi_edges(args.n_vertices, 2.0))
+        res = m.run(edges, _mesh(args))
+        print(f"The original graph has {res.n_paths} paths "
+              f"({res.n_rounds} rounds)")
+
+    elif args.cmd == "als":
+        from tpu_distalg.models import als as m
+
+        res = m.fit(_mesh(args), m.ALSConfig(
+            lam=args.lam, m=args.m, n=args.n, k=args.k,
+            n_iterations=args.n_iterations))
+        for t, e in enumerate(res.rmse_history):
+            print(f"iterations: {t}, rmse: {float(e):f}")
+
+    elif args.cmd == "mc":
+        from tpu_distalg.models import monte_carlo as m
+
+        pi, n_used = m.estimate_pi(
+            _mesh(args), m.MonteCarloConfig(n=args.n))
+        print(f"Pi is roughly {pi:f}")
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

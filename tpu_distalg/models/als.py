@@ -24,10 +24,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from tpu_distalg.ops import linalg
-from tpu_distalg.parallel import DATA_AXIS, pad_rows
+from tpu_distalg.parallel import (
+    DATA_AXIS,
+    data_sharding,
+    pad_rows,
+    replicated_sharding,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,7 +69,7 @@ def synthesize_rank_k(config: ALSConfig) -> np.ndarray:
 
 def make_fit_fn(mesh: Mesh, config: ALSConfig):
     denom = config.m * config.n  # true element count, not padded
-    rows = NamedSharding(mesh, P(DATA_AXIS, None))
+    rows = data_sharding(mesh, ndim=2)
 
     def fit(R, U0, V0):
         def sweep(carry, _):
@@ -92,6 +97,10 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
         R: np.ndarray | None = None) -> ALSResult:
     if R is None:
         R = synthesize_rank_k(config)
+    elif R.shape != (config.m, config.n):
+        # caller-supplied R wins: m/n drive the RMSE denominator, the
+        # Gram regularisation scale, and the U truncation
+        config = dataclasses.replace(config, m=R.shape[0], n=R.shape[1])
     n_shards = mesh.shape[DATA_AXIS]
     R_padded, _mask = pad_rows(np.asarray(R, dtype=np.float32), n_shards)
 
@@ -101,8 +110,8 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
     U0 = np.zeros((R_padded.shape[0], config.k), dtype=np.float32)
     V0 = rng.random((config.n, config.k), dtype=np.float32)
 
-    rows = NamedSharding(mesh, P(DATA_AXIS, None))
-    repl = NamedSharding(mesh, P())
+    rows = data_sharding(mesh, ndim=2)
+    repl = replicated_sharding(mesh)
     R_dev = jax.device_put(jnp.asarray(R_padded), rows)
     U_dev = jax.device_put(jnp.asarray(U0), rows)
     V_dev = jax.device_put(jnp.asarray(V0), repl)

@@ -28,7 +28,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_distalg.ops import graph as gops
-from tpu_distalg.parallel import DATA_AXIS, data_parallel, pad_rows, tree_allreduce_sum
+from tpu_distalg.parallel import (
+    DATA_AXIS,
+    data_parallel,
+    data_sharding,
+    pad_rows,
+    tree_allreduce_sum,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,7 +128,6 @@ def run(edges: np.ndarray, mesh: Mesh,
 
     ev = np.stack([el.src, el.dst], axis=1)
     ev_padded, emask = pad_rows(ev, n_shards)
-    from tpu_distalg.parallel import data_sharding
     shard1 = data_sharding(mesh, 1)
     src = jax.device_put(jnp.asarray(ev_padded[:, 0]), shard1)
     dst = jax.device_put(jnp.asarray(ev_padded[:, 1]), shard1)

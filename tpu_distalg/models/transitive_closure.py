@@ -20,10 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from tpu_distalg.ops import graph as gops
-from tpu_distalg.parallel import DATA_AXIS
+from tpu_distalg.parallel import DATA_AXIS, data_sharding
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,7 +50,7 @@ def run(edges: np.ndarray, mesh: Mesh,
 
     adj = np.zeros((V, V), dtype=bool)
     adj[el.src, el.dst] = True
-    rows = NamedSharding(mesh, P(DATA_AXIS, None))
+    rows = data_sharding(mesh, ndim=2)
 
     @jax.jit
     def fixpoint(edges_bool):

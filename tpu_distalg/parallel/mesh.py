@@ -47,17 +47,23 @@ def emulate_devices(n: int = 8, platform: str = "cpu") -> None:
 
 
 def local_device_count() -> int:
-    return len(jax.devices())
+    """Devices attached to THIS process (differs from the global count on
+    multi-host slices)."""
+    return jax.local_device_count()
 
 
 def multihost_initialize(**kwargs) -> None:
     """Initialise the multi-host runtime (DCN-connected TPU slices).
 
-    Thin wrapper over ``jax.distributed.initialize`` so workloads never import
-    it directly; a no-op when running single-process (the common test path).
+    Must run before anything initialises an XLA backend (same contract as
+    ``jax.distributed.initialize``, which it wraps). Idempotent: a no-op if
+    the distributed client is already up.
     """
-    if jax.process_count() > 1 or kwargs:
-        jax.distributed.initialize(**kwargs)
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is not None:  # already initialised
+        return
+    jax.distributed.initialize(**kwargs)
 
 
 def get_mesh(

@@ -1,0 +1,74 @@
+"""Checkpoint / resume.
+
+The reference has none (SURVEY.md §5): training state lives only in driver
+RAM and the only artifacts are PNG plots. Here any pytree of arrays (model,
+optimizer state, step counter) can be saved per-N-steps and restored as one
+msgpack file per step (flax serialization, atomic rename). Note ``save``
+gathers every leaf to this host via ``np.asarray`` — fine for the replicated
+model/optimizer state these workloads carry; use orbax directly for
+multi-host sharded checkpoints of device-resident datasets.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)\.msgpack$")
+
+
+def save(ckpt_dir: str, tree: Any, step: int) -> str:
+    """Write ``tree`` at ``ckpt_dir/step_<step>.msgpack`` (atomic rename)."""
+    from flax import serialization
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    host_tree = jax.tree.map(np.asarray, tree)
+    payload = serialization.msgpack_serialize(host_tree)
+    path = os.path.join(ckpt_dir, f"step_{step}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(ckpt_dir)
+        if (m := _STEP_RE.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
+    """Load (tree, step); ``step=None`` loads the newest checkpoint."""
+    from flax import serialization
+
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}.msgpack")
+    with open(path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    return tree, step
+
+
+def prune(ckpt_dir: str, keep: int = 3) -> None:
+    """Delete all but the newest ``keep`` checkpoints."""
+    if not os.path.isdir(ckpt_dir):
+        return
+    steps = sorted(
+        int(m.group(1))
+        for name in os.listdir(ckpt_dir)
+        if (m := _STEP_RE.match(name))
+    )
+    for s in steps[:-keep] if keep else steps:
+        os.remove(os.path.join(ckpt_dir, f"step_{s}.msgpack"))

@@ -56,21 +56,26 @@ def draw_acc_plot(accs, path: str, alpha: float = 0.9, title: str =
 
 
 class StepTimer:
-    """Wall-clock timer that blocks on device completion — the honest way to
-    time XLA programs (dispatch is async)."""
+    """Wall-clock timer for XLA programs. Dispatch is async, so assign the
+    program's output to ``.result`` inside the block — ``__exit__`` calls
+    ``jax.block_until_ready`` on it before reading the clock::
+
+        with StepTimer() as t:
+            t.result = train_fn(...)
+        print(t.elapsed)
+    """
 
     def __init__(self):
         self._t0 = None
         self.elapsed = 0.0
+        self.result = None
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if exc[0] is None and self.result is not None:
+            jax.block_until_ready(self.result)
         self.elapsed = time.perf_counter() - self._t0
         return False
-
-    @staticmethod
-    def block(tree):
-        jax.block_until_ready(tree)
