@@ -43,6 +43,10 @@ class SSGDConfig:
     seed: int = 42
     init_seed: int = 7
     eval_test: bool = True
+    # TPU perf knobs (not in the reference):
+    x_dtype: str = "float32"    # 'bfloat16' halves HBM traffic for X
+    use_pallas: bool = False    # fused one-pass gradient kernel
+    pallas_block_rows: int = 2048
 
 
 @dataclasses.dataclass
@@ -55,13 +59,24 @@ class TrainResult:
         return float(self.accs[-1])
 
 
-def _local_grad(X, y, mask, w):
-    g, cnt = logistic.grad_sum(X, y, w, mask)
-    return tree_allreduce_sum((g, cnt))
-
-
 def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Build the jitted scan over ``n_iterations`` SSGD steps."""
+    if config.use_pallas:
+        from tpu_distalg.ops import pallas_kernels
+
+        interpret = next(iter(mesh.devices.flat)).platform != "tpu"
+
+        def _local_grad(X, y, mask, w):
+            g, cnt = pallas_kernels.fused_grad_sum(
+                X, y, mask, w,
+                block_rows=config.pallas_block_rows, interpret=interpret,
+            )
+            return tree_allreduce_sum((g, cnt))
+    else:
+        def _local_grad(X, y, mask, w):
+            g, cnt = logistic.grad_sum(X, y, w, mask)
+            return tree_allreduce_sum((g, cnt))
+
     grad_fn = data_parallel(
         _local_grad,
         mesh,
@@ -97,7 +112,9 @@ def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: SSGDConfig = SSGDConfig(),
 ) -> TrainResult:
-    Xs = parallelize(X_train, mesh)
+    Xs = parallelize(
+        X_train, mesh, dtype=jnp.dtype(config.x_dtype)
+    )
     ys = parallelize(y_train, mesh)
     w0 = logistic.init_weights(
         prng.root_key(config.init_seed), X_train.shape[1]

@@ -1,0 +1,141 @@
+"""ctypes bindings for the native (C++) ingest runtime.
+
+Loads ``libtda_ingest.so`` (built by ``native/Makefile`` into this package
+directory, or auto-built on first use when a compiler is present). Every
+entry point has a NumPy fallback, so the framework works without the
+native library — just slower at 10M+ edge scale.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB_NAME = "libtda_ingest.so"
+_here = os.path.dirname(__file__)
+_lib = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    src_dir = os.path.join(_here, os.pardir, os.pardir, "native")
+    makefile = os.path.join(src_dir, "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", src_dir], check=True, capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The loaded library, building it on first use if needed; None when
+    unavailable (callers fall back to NumPy)."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = os.path.join(_here, _LIB_NAME)
+    if not os.path.exists(path) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.tda_dedupe_edges.argtypes = [i64p, i64p, ctypes.c_int64]
+    lib.tda_dedupe_edges.restype = ctypes.c_int64
+    lib.tda_out_degree.argtypes = [i64p, ctypes.c_int64, i32p,
+                                   ctypes.c_int64]
+    lib.tda_out_degree.restype = None
+    lib.tda_csr_offsets.argtypes = [i64p, ctypes.c_int64, i64p,
+                                    ctypes.c_int64]
+    lib.tda_csr_offsets.restype = None
+    lib.tda_parse_edges_text.argtypes = [ctypes.c_char_p, i64p, i64p,
+                                         ctypes.c_int64]
+    lib.tda_parse_edges_text.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def dedupe_edges_pair(edges: np.ndarray):
+    """Sorted, deduplicated (src, dst) contiguous column pair from an
+    (E, 2) int edge array — the zero-extra-copy native interface.
+
+    Native path: pack-sort-unique in C++; fallback: ``np.unique(axis=0)``.
+    Matches ``links.distinct()`` set semantics (reference pagerank.py:41).
+    """
+    edges = np.ascontiguousarray(edges, dtype=np.int64)
+    lib = load()
+    if lib is None or len(edges) == 0:
+        uniq = np.unique(edges, axis=0)
+        return np.ascontiguousarray(uniq[:, 0]), np.ascontiguousarray(
+            uniq[:, 1]
+        )
+    src = np.ascontiguousarray(edges[:, 0])
+    dst = np.ascontiguousarray(edges[:, 1])
+    m = lib.tda_dedupe_edges(src, dst, len(src))
+    return src[:m], dst[:m]
+
+
+def dedupe_edges(edges: np.ndarray) -> np.ndarray:
+    """(E', 2) stacked variant of ``dedupe_edges_pair``."""
+    src, dst = dedupe_edges_pair(edges)
+    return np.stack([src, dst], axis=1)
+
+
+def out_degree(src: np.ndarray, n_vertices: int) -> np.ndarray:
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    if len(src) and (m := int(src.max())) >= n_vertices:
+        # the C++ histogram writes degree[src[i]] unchecked — reject
+        # out-of-range ids here rather than corrupt memory
+        raise ValueError(
+            f"src id {m} out of range for n_vertices={n_vertices}"
+        )
+    lib = load()
+    if lib is None:
+        return np.bincount(src, minlength=n_vertices).astype(np.int32)
+    deg = np.zeros((n_vertices,), dtype=np.int32)
+    lib.tda_out_degree(src, len(src), deg, n_vertices)
+    return deg
+
+
+def csr_offsets(sorted_src: np.ndarray, n_vertices: int) -> np.ndarray:
+    """Row-offset array (n_vertices+1,) for edges sorted by src."""
+    sorted_src = np.ascontiguousarray(sorted_src, dtype=np.int64)
+    lib = load()
+    if lib is None:
+        counts = np.bincount(sorted_src, minlength=n_vertices)
+        out = np.zeros((n_vertices + 1,), dtype=np.int64)
+        np.cumsum(counts, out=out[1:])
+        return out
+    out = np.zeros((n_vertices + 1,), dtype=np.int64)
+    lib.tda_csr_offsets(sorted_src, len(sorted_src), out, n_vertices)
+    return out
+
+
+def parse_edges_text(path: str, capacity: int) -> np.ndarray:
+    """Parse a '#'-commented whitespace edge-list file into (E, 2) int64."""
+    lib = load()
+    if lib is None:
+        return np.loadtxt(path, dtype=np.int64, comments="#").reshape(-1, 2)
+    src = np.empty((capacity,), dtype=np.int64)
+    dst = np.empty((capacity,), dtype=np.int64)
+    n = lib.tda_parse_edges_text(path.encode(), src, dst, capacity)
+    if n == -1:
+        raise FileNotFoundError(path)
+    if n == -2:
+        raise ValueError(f"edge file exceeds capacity {capacity}")
+    return np.stack([src[:n], dst[:n]], axis=1)

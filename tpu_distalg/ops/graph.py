@@ -38,14 +38,19 @@ def prepare_edges(edges: np.ndarray, n_vertices: int | None = None) -> EdgeList:
 
     Host-side preprocessing standing in for ``links.distinct()`` +
     ``groupByKey`` (``pagerank.py:41``): set semantics once, up front,
-    instead of a shuffle per run.
+    instead of a shuffle per run. Uses the native (C++) ingest library when
+    built (``tpu_distalg.native``), with a NumPy fallback.
     """
-    edges = np.asarray(edges)
-    edges = np.unique(edges, axis=0)  # distinct
-    src, dst = edges[:, 0], edges[:, 1]
+    from tpu_distalg import native
+
+    src, dst = native.dedupe_edges_pair(np.asarray(edges))  # distinct+sort
     if n_vertices is None:
-        n_vertices = int(edges.max()) + 1 if len(edges) else 0
-    out_degree = np.bincount(src, minlength=n_vertices)
+        max_id = max(
+            int(src.max()) if len(src) else -1,
+            int(dst.max()) if len(dst) else -1,
+        )
+        n_vertices = max_id + 1
+    out_degree = native.out_degree(src, n_vertices)
     return EdgeList(
         src=src.astype(np.int32),
         dst=dst.astype(np.int32),

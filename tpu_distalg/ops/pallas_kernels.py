@@ -1,0 +1,105 @@
+"""Pallas TPU kernels for the hot SSGD path.
+
+The XLA-fused SSGD step reads X from HBM twice per iteration — once for the
+forward matvec ``X·w`` and once for the gradient contraction ``Xᵀ·resid``
+(``tpu_distalg.ops.logistic.grad_sum``). At 1M×128 f32 that is ~1 GB of HBM
+traffic per step and the step is bandwidth-bound. This kernel fuses
+forward, masking and backward into one pass over X: each row block is
+loaded into VMEM once, used for both matmuls (MXU), and the (D,) gradient
+accumulates in a VMEM scratch across the sequential grid.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md): last dim must tile
+by 128 — the wrapper zero-pads the feature dim (zero columns produce zero
+gradient entries, sliced off afterwards); row blocks tile the sublane dim.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _grad_kernel(x_ref, y_ref, mask_ref, w_ref, g_ref, cnt_ref, acc_ref,
+                 cacc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        cacc_ref[0, 0] = 0.0
+
+    x = x_ref[:]                                   # (B, D) in VMEM
+    w = w_ref[:]                                   # (D, 1)
+    z = jnp.dot(x, w, preferred_element_type=jnp.float32)  # (B, 1) MXU
+    resid = (jax.nn.sigmoid(z) - y_ref[:]) * mask_ref[:]   # (B, 1) VPU
+    # second MXU pass over the SAME VMEM-resident block: Xᵀ·resid
+    acc_ref[:] += jax.lax.dot_general(
+        x, resid, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                              # (D, 1)
+    cacc_ref[0, 0] += jnp.sum(mask_ref[:])
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _done():
+        g_ref[:] = acc_ref[:]
+        cnt_ref[0, 0] = cacc_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def fused_grad_sum(X, y, mask, w, *, block_rows: int = 2048,
+                   interpret: bool = False):
+    """Masked (Σ gradient, count) in ONE pass over X.
+
+    Same contract as ``logistic.grad_sum`` (the reference's treeAggregate
+    pair, ``ssgd.py:99-103``) for one shard. X may be f32 or bf16; the
+    accumulator is always f32.
+    """
+    n, d = X.shape
+    d_pad = (-d) % 128
+    b = min(block_rows, n)
+    n_pad = (-n) % b
+    if d_pad or n_pad:
+        X = jnp.pad(X, ((0, n_pad), (0, d_pad)))
+        y = jnp.pad(y, (0, n_pad))
+        mask = jnp.pad(mask, (0, n_pad))  # padded rows masked out
+        w = jnp.pad(w, (0, d_pad))
+    n_t, d_t = X.shape
+
+    grid = (n_t // b,)
+    g, cnt = pl.pallas_call(
+        _grad_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, d_t), lambda i: (i, 0)),
+            pl.BlockSpec((b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d_t, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d_t, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d_t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d_t, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(
+        X,
+        y.reshape(-1, 1).astype(jnp.float32),
+        mask.reshape(-1, 1).astype(jnp.float32),
+        w.reshape(-1, 1).astype(X.dtype),
+    )
+    return g[:d, 0], cnt[0, 0]

@@ -1,0 +1,105 @@
+"""Ring pipelines over the mesh data axis — sequence/context parallelism.
+
+The reference has no sequences or attention (SURVEY.md §5: longest
+"sequence" is a 31-feature row), but the communication layer of a TPU
+framework must scale to long-context workloads (ring attention /
+all-to-all sequence parallelism), so these are first-class here:
+
+  * ``ring_allgather_matmul`` — A·Bᵀ where both operands are row-sharded:
+    B blocks rotate around the ring (``ppermute`` over ICI) while partial
+    products accumulate, overlapping communication with MXU compute — the
+    standard ICI pipeline (cf. the scaling-book collective-matmul recipe).
+  * ``ring_attention`` — exact blockwise attention with online softmax
+    accumulation (Liu et al. ring attention; Milakov-Gimelshein online
+    softmax): Q stays put, K/V blocks rotate; memory per chip is
+    O(S_local²) instead of O(S²), so sequence length scales linearly with
+    the ring size.
+
+Both are shard_map bodies: run them inside ``data_parallel`` with
+sequence-sharded operands.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_distalg.parallel.mesh import DATA_AXIS
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def ring_allgather_matmul(a_local, b_local, axis_name: str = DATA_AXIS):
+    """Per-shard rows of A·Bᵀ with B row-sharded: (Sa_l, d) x (Sb, d)ᵀ.
+
+    Each of the n ring steps multiplies the resident B block (MXU) while the
+    next block is in flight (XLA overlaps the ppermute with the dot).
+    Returns the (Sa_l, Sb) block of the full product owned by this shard.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sb = b_local.shape[0]
+
+    def body(i, carry):
+        b, out = carry
+        # the block currently resident came from shard (my - i) mod n
+        src = (my - i) % n
+        part = jnp.dot(a_local, b.T, preferred_element_type=jnp.float32)
+        out = lax.dynamic_update_slice(out, part, (0, src * sb))
+        b = lax.ppermute(b, axis_name, _ring_perm(n))
+        return b, out
+
+    out0 = jnp.zeros((a_local.shape[0], n * sb), dtype=jnp.float32)
+    _, out = lax.fori_loop(0, n, body, (b_local, out0))
+    return out
+
+
+def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
+                   scale: float | None = None):
+    """Exact attention over a sequence sharded around the ring.
+
+    ``q, k, v``: (S_local, d) per shard. K/V blocks rotate; each arrival
+    updates the online-softmax state (running max m, normalizer l,
+    accumulator o) so the result is exactly ``softmax(QKᵀ/√d)·V`` over the
+    FULL sequence, never materialising more than one (S_local, S_local)
+    score block per chip.
+    """
+    n = lax.axis_size(axis_name)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def body(i, carry):
+        kb, vb, o, m, l = carry
+        scores = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * s
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # rescale previous accumulator to the new max
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[:, None] + jnp.dot(
+            p.astype(vb.dtype), vb, preferred_element_type=jnp.float32
+        )
+        kb = lax.ppermute(kb, axis_name, _ring_perm(n))
+        vb = lax.ppermute(vb, axis_name, _ring_perm(n))
+        return kb, vb, o, m_new, l
+
+    o0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
+    m0 = jnp.full((q.shape[0],), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
+    _, _, o, _, l = lax.fori_loop(0, n, body, (k, v, o0, m0, l0))
+    return o / l[:, None]
+
+
+def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
+    """DeepSpeed-Ulysses-style exchange: (S_local, H, d) sequence-sharded →
+    (S, H_local, d) head-sharded, in one all_to_all over the axis."""
+    n = lax.axis_size(axis_name)
+    s_l, h, d = x.shape
+    assert h % n == 0, f"heads {h} must divide axis size {n}"
+    x = x.reshape(s_l, n, h // n, d)
+    out = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                         tiled=False)
+    return out.reshape(n * s_l, h // n, d)

@@ -1,0 +1,44 @@
+"""Profiling and tracing helpers.
+
+The reference's only observability is ``print`` per iteration plus Spark's
+(unused) web UI (SURVEY.md §5). Here: a TensorBoard/Perfetto trace context
+(``jax.profiler``) and an honest steps/sec measurement that blocks on
+device completion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace viewable in TensorBoard / Perfetto:
+
+        with profiling.trace("/tmp/trace"):
+            out = train_fn(...)
+            jax.block_until_ready(out)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
+                  warmup: bool = True) -> float:
+    """Best-of-``repeats`` throughput of ``fn(*args)``, where one call runs
+    ``steps`` device-side steps (e.g. a scan segment). Blocks on the result
+    each repeat, so dispatch-async bias is excluded."""
+    if warmup:
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return steps / best
