@@ -1,0 +1,86 @@
+"""Checkpoint/resume: segmented training must equal straight-through
+training bitwise, and resume must continue from the saved step."""
+
+import numpy as np
+import pytest
+
+from tpu_distalg.models import ssgd
+
+
+@pytest.fixture(scope="module")
+def data(cancer_data):
+    return cancer_data
+
+
+def test_segmented_equals_straight(mesh8, data, tmp_path):
+    X_train, y_train, X_test, y_test = data
+    cfg = ssgd.SSGDConfig(n_iterations=120)
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg)
+    seg = ssgd.train(
+        X_train, y_train, X_test, y_test, mesh8, cfg,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=50,
+    )
+    np.testing.assert_array_equal(np.asarray(straight.w), np.asarray(seg.w))
+    np.testing.assert_array_equal(
+        np.asarray(straight.accs), np.asarray(seg.accs)
+    )
+
+
+def test_resume_from_checkpoint(mesh8, data, tmp_path):
+    """Kill after 60 steps (checkpointed), rerun: must complete to 120 and
+    match the straight run."""
+    X_train, y_train, X_test, y_test = data
+    d = str(tmp_path / "ck")
+    cfg60 = ssgd.SSGDConfig(n_iterations=60)
+    ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg60,
+               checkpoint_dir=d, checkpoint_every=60)
+
+    cfg120 = ssgd.SSGDConfig(n_iterations=120)
+    resumed = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg120,
+                         checkpoint_dir=d, checkpoint_every=60)
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg120)
+    np.testing.assert_array_equal(
+        np.asarray(straight.w), np.asarray(resumed.w)
+    )
+    assert resumed.accs.shape == (120,)
+
+
+def test_nan_guard_trips(mesh8, data, tmp_path):
+    X_train, y_train, X_test, y_test = data
+    X_bad = X_train.copy()
+    X_bad[0, 0] = np.nan
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        ssgd.train(X_bad, y_train, X_test, y_test, mesh8,
+                   ssgd.SSGDConfig(n_iterations=20),
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every=10)
+
+
+def test_stale_checkpoint_past_n_iterations_rejected(mesh8, data, tmp_path):
+    X_train, y_train, X_test, y_test = data
+    d = str(tmp_path / "ck")
+    ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+               ssgd.SSGDConfig(n_iterations=100), checkpoint_dir=d,
+               checkpoint_every=100)
+    with pytest.raises(ValueError, match="past"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                   ssgd.SSGDConfig(n_iterations=50), checkpoint_dir=d)
+
+
+def test_checkpoints_pruned(mesh8, data, tmp_path):
+    import os
+    X_train, y_train, X_test, y_test = data
+    d = str(tmp_path / "ck")
+    ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+               ssgd.SSGDConfig(n_iterations=200), checkpoint_dir=d,
+               checkpoint_every=40)
+    files = [f for f in os.listdir(d) if f.endswith(".msgpack")]
+    assert len(files) <= 3
+
+
+def test_pallas_with_fixed_sampler_rejected(mesh8, data):
+    X_train, y_train, X_test, y_test = data
+    with pytest.raises(ValueError, match="use_pallas"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                   ssgd.SSGDConfig(n_iterations=5, sampler="fixed",
+                                   use_pallas=True))

@@ -89,3 +89,13 @@ def test_local_sgd_resample_mode(mesh4, cancer_data):
         ma.MAConfig(n_iterations=100, resample_per_local_step=True),
     )
     assert res.final_acc >= 0.80
+
+
+def test_ssgd_fixed_sampler(mesh8, cancer_data):
+    """Gather-based fixed-size sampler (TPU HBM-traffic-optimal path)."""
+    X_train, y_train, X_test, y_test = cancer_data
+    res = ssgd.train(
+        X_train, y_train, X_test, y_test, mesh8,
+        ssgd.SSGDConfig(n_iterations=1500, sampler="fixed"),
+    )
+    assert res.final_acc >= 0.88, res.final_acc

@@ -47,6 +47,11 @@ class SSGDConfig:
     x_dtype: str = "float32"    # 'bfloat16' halves HBM traffic for X
     use_pallas: bool = False    # fused one-pass gradient kernel
     pallas_block_rows: int = 2048
+    # 'bernoulli' = reference-parity mask over ALL rows (sample() semantics,
+    # ssgd.py:97); 'fixed' = gather exactly frac·n_local rows per shard —
+    # touches only the minibatch's HBM bytes (≈1/frac less traffic), like
+    # Spark's per-partition sampling it is shard-count dependent
+    sampler: str = "bernoulli"
 
 
 @dataclasses.dataclass
@@ -59,8 +64,41 @@ class TrainResult:
         return float(self.accs[-1])
 
 
+def _build_scan(config: SSGDConfig, sample_and_grad):
+    """Shared step/scan builder: ``sample_and_grad(X, y, valid, w, t)`` →
+    global (Σ grad, count); update rule and eval are identical for every
+    sampler (``ssgd.py:105`` semantics)."""
+
+    def train(X, y, valid, X_test, y_test, w0, t0=0):
+        def step(w, t):
+            g, cnt = sample_and_grad(X, y, valid, w, t)
+            n_batch = jnp.maximum(cnt, 1.0)  # guard empty sample
+            reg = logistic.reg_gradient(
+                w, config.reg_type, config.elastic_alpha
+            )
+            w = w - config.eta * (g / n_batch + config.lam * reg)  # ssgd.py:105
+            acc = (
+                metrics.binary_accuracy(X_test @ w, y_test)
+                if config.eval_test
+                else jnp.float32(0)
+            )
+            return w, acc
+
+        # absolute step ids (t0 offset): segmented checkpoint/resume runs
+        # sample identical minibatches to a straight-through run
+        return jax.lax.scan(
+            step, w0, jnp.arange(config.n_iterations) + t0
+        )
+
+    return jax.jit(train)
+
+
 def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Build the jitted scan over ``n_iterations`` SSGD steps."""
+    if config.sampler == "fixed":
+        return _make_train_fn_fixed(mesh, config, n_padded)
+    if config.sampler != "bernoulli":
+        raise ValueError(f"unknown sampler {config.sampler!r}")
     if config.use_pallas:
         from tpu_distalg.ops import pallas_kernels
 
@@ -85,33 +123,71 @@ def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     )
     key = prng.root_key(config.seed)
 
-    def train(X, y, valid, X_test, y_test, w0):
-        def step(w, t):
-            mask = sampling.bernoulli_mask(
-                key, t, n_padded, config.mini_batch_fraction, valid
-            )
-            g, cnt = grad_fn(X, y, mask, w)
-            n_batch = jnp.maximum(cnt, 1.0)  # guard empty sample
-            reg = logistic.reg_gradient(
-                w, config.reg_type, config.elastic_alpha
-            )
-            w = w - config.eta * (g / n_batch + config.lam * reg)  # ssgd.py:105
-            acc = (
-                metrics.binary_accuracy(X_test @ w, y_test)
-                if config.eval_test
-                else jnp.float32(0)
-            )
-            return w, acc
+    def sample_and_grad(X, y, valid, w, t):
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid
+        )
+        return grad_fn(X, y, mask, w)
 
-        return jax.lax.scan(step, w0, jnp.arange(config.n_iterations))
+    return _build_scan(config, sample_and_grad)
 
-    return jax.jit(train)
+
+def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
+    """Fixed-size per-shard gather sampling: each shard draws exactly
+    ``frac·n_local`` local row indices per step and gathers only those rows
+    — the HBM-traffic-optimal sampler (the Bernoulli mask touches every
+    row of X every step). Gathered padding rows carry zero mask weight."""
+    from jax import lax
+
+    from tpu_distalg.parallel import DATA_AXIS
+
+    if config.use_pallas:
+        raise ValueError(
+            "use_pallas applies to the 'bernoulli' sampler only; the "
+            "'fixed' sampler's gather path does not use the fused kernel"
+        )
+
+    n_shards = mesh.shape[DATA_AXIS]
+    n_local = n_padded // n_shards
+    b_local = max(1, round(config.mini_batch_fraction * n_local))
+    key = prng.root_key(config.seed)
+
+    def _local_grad(X, y, valid, w, t):
+        shard = lax.axis_index(DATA_AXIS)
+        k = jax.random.fold_in(jax.random.fold_in(key, t), shard)
+        idx = jax.random.randint(k, (b_local,), 0, X.shape[0])
+        g, cnt = logistic.grad_sum(X[idx], y[idx], w, valid[idx])
+        return tree_allreduce_sum((g, cnt))
+
+    grad_fn = data_parallel(
+        _local_grad,
+        mesh,
+        in_specs=(P("data", None), P("data"), P("data"), P(), P()),
+        out_specs=(P(), P()),
+    )
+
+    return _build_scan(config, grad_fn)
 
 
 def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: SSGDConfig = SSGDConfig(),
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
 ) -> TrainResult:
+    """End-to-end training; optionally checkpointed/resumable.
+
+    With ``checkpoint_dir``, training runs in compiled segments of
+    ``checkpoint_every`` steps; after each segment the (w, step, accs)
+    state is saved (msgpack) and a non-finite-weights guard trips with a
+    clear error (the NaN hazard SURVEY.md §5 flags in the reference is
+    impossible to see there — it has no guards at all). An existing
+    checkpoint in the directory resumes from its absolute step; segmented
+    and straight-through runs produce bitwise-identical weights.
+    """
+    import numpy as np
+
     Xs = parallelize(
         X_train, mesh, dtype=jnp.dtype(config.x_dtype)
     )
@@ -119,8 +195,55 @@ def train(
     w0 = logistic.init_weights(
         prng.root_key(config.init_seed), X_train.shape[1]
     )
-    fn = make_train_fn(mesh, config, Xs.n_padded)
-    w, accs = fn(
-        Xs.data, ys.data, Xs.mask, jnp.asarray(X_test), jnp.asarray(y_test), w0
-    )
-    return TrainResult(w=w, accs=accs)
+    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+
+    if checkpoint_dir is None:
+        fn = make_train_fn(mesh, config, Xs.n_padded)
+        w, accs = fn(Xs.data, ys.data, Xs.mask, X_te, y_te, w0)
+        return TrainResult(w=w, accs=accs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    start = 0
+    accs_parts = []
+    w = w0
+    if ckpt.latest_step(checkpoint_dir) is not None:
+        state, start = ckpt.restore(checkpoint_dir)
+        if start > config.n_iterations:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} is at step {start}, past "
+                f"n_iterations={config.n_iterations}; use a fresh "
+                f"directory or raise n_iterations"
+            )
+        w = jnp.asarray(state["w"])
+        accs_parts = [np.asarray(state["accs"])]
+
+    seg_fns = {}
+    t = start
+    while t < config.n_iterations:
+        seg = min(checkpoint_every, config.n_iterations - t)
+        if seg not in seg_fns:
+            seg_fns[seg] = make_train_fn(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                Xs.n_padded,
+            )
+        w, accs = seg_fns[seg](
+            Xs.data, ys.data, Xs.mask, X_te, y_te, w, t0=t
+        )
+        if not bool(jnp.all(jnp.isfinite(w))):
+            raise FloatingPointError(
+                f"non-finite weights after step {t + seg} — check eta/"
+                f"regularisation (guard absent in the reference)"
+            )
+        t += seg
+        accs_parts.append(np.asarray(accs))
+        ckpt.save(
+            checkpoint_dir,
+            {"w": np.asarray(w),
+             "accs": np.concatenate(accs_parts)},
+            step=t,
+        )
+        ckpt.prune(checkpoint_dir, keep=3)
+    all_accs = (jnp.concatenate([jnp.asarray(a) for a in accs_parts])
+                if accs_parts else jnp.zeros((0,)))
+    return TrainResult(w=w, accs=all_accs)

@@ -57,7 +57,14 @@ def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(ckpt_dir, f"step_{step}.msgpack")
     with open(path, "rb") as f:
-        tree = serialization.msgpack_restore(f.read())
+        payload = f.read()
+    try:
+        tree = serialization.msgpack_restore(payload)
+    except Exception as e:
+        raise ValueError(
+            f"corrupt checkpoint {path} ({type(e).__name__}: {e}); delete "
+            f"it to resume from an earlier step"
+        ) from e
     return tree, step
 
 
