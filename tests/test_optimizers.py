@@ -99,3 +99,26 @@ def test_ssgd_fixed_sampler(mesh8, cancer_data):
         ssgd.SSGDConfig(n_iterations=1500, sampler="fixed"),
     )
     assert res.final_acc >= 0.88, res.final_acc
+
+
+def test_ssgd_feature_sharded_matches_dp(mesh_2x4, mesh1, cancer_data):
+    """dp*tp (features over the model axis) must match the pure-dp result:
+    same Bernoulli masks (topology-independent), same math, different
+    sharding. Feature dim 31 pads to 32 over 4 model shards."""
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=100)
+    tp = ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
+                    ssgd.SSGDConfig(n_iterations=100, feature_sharded=True))
+    dp = ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg)
+    assert tp.w.shape == dp.w.shape == (31,)
+    np.testing.assert_allclose(
+        np.asarray(tp.w), np.asarray(dp.w), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_ssgd_feature_sharded_invalid_combos(mesh_2x4, cancer_data):
+    X_train, y_train, X_test, y_test = cancer_data
+    with pytest.raises(ValueError, match="feature_sharded"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
+                   ssgd.SSGDConfig(n_iterations=5, feature_sharded=True,
+                                   sampler="fixed"))

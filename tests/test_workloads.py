@@ -191,3 +191,26 @@ def test_monte_carlo_chunking_equivalence(mesh8):
         mesh8, monte_carlo.MonteCarloConfig(n=200_000, chunk=1 << 12)
     )
     assert abs(big - small) < 0.05
+
+
+def test_display_clusters_plot(mesh8, tmp_path):
+    import os
+
+    from tpu_distalg.utils import metrics
+
+    pts = datasets.toy_kmeans_matrix()
+    res = kmeans.fit(pts, mesh8)
+    path = str(tmp_path / "clusters.png")
+    metrics.display_clusters(
+        pts, np.asarray(res.assignments)[: len(pts)], path, k=2
+    )
+    assert os.path.getsize(path) > 1000
+
+
+def test_als_model_axis_sharding(mesh_2x4):
+    """V sharded over the model axis (n=500 not divisible by 4 → falls
+    back; n=512 shards) — result must match the replicated path."""
+    cfg = als.ALSConfig(m=64, n=512, k=8, n_iterations=6, lam=0.0)
+    res = als.fit(mesh_2x4, cfg)
+    assert res.final_rmse < 1e-2
+    assert res.V.shape == (512, 8)

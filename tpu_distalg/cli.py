@@ -83,6 +83,8 @@ def main(argv=None):
     p.add_argument("--n-points", type=int, default=0,
                    help="0 = the reference's toy 6x2 matrix; else a "
                         "Gaussian mixture of this many points")
+    p.add_argument("--plot", type=str, default=None,
+                   help="save a cluster scatter PNG (2-D data)")
 
     p = sub.add_parser("pagerank")
     p.add_argument("--n-slices", type=int, default=0)
@@ -164,6 +166,16 @@ def main(argv=None):
             converge_dist=args.converge_dist))
         print(f"Final centers: {res.centers.tolist()}")
         print(f"iterations run: {res.n_iterations_run}")
+        if args.plot:
+            from tpu_distalg.utils import metrics
+
+            import numpy as np
+
+            metrics.display_clusters(
+                pts, np.asarray(res.assignments)[: len(pts)], args.plot,
+                k=args.k,
+            )
+            print(f"saved plot: {args.plot}")
 
     elif args.cmd == "pagerank":
         from tpu_distalg.models import pagerank as m

@@ -68,8 +68,20 @@ def synthesize_rank_k(config: ALSConfig) -> np.ndarray:
 
 
 def make_fit_fn(mesh: Mesh, config: ALSConfig):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_distalg.parallel import MODEL_AXIS
+
     denom = config.m * config.n  # true element count, not padded
     rows = data_sharding(mesh, ndim=2)
+    # shard the item factor over the model axis when it divides evenly —
+    # the model-parallel einsum SURVEY.md §2.3 calls for, replacing the
+    # reference's broadcast of full V to every task (:46-48)
+    n_model = mesh.shape[MODEL_AXIS]
+    v_sharding = (
+        NamedSharding(mesh, P(MODEL_AXIS, None))
+        if n_model > 1 and config.n % n_model == 0 else None
+    )
 
     def fit(R, U0, V0):
         def sweep(carry, _):
@@ -81,6 +93,8 @@ def make_fit_fn(mesh: Mesh, config: ALSConfig):
             # V-update against Rᵀ: (UᵀU + λ·m·I) vⱼ = Uᵀ R[:,j]  (:60-62)
             G_u = linalg.gram(U, config.lam, config.m)
             V = linalg.solve_factor_block(G_u, U, R.T)
+            if v_sharding is not None:
+                V = lax.with_sharding_constraint(V, v_sharding)
             diff = R - U @ V.T  # padded rows are exactly zero on both sides
             err = jnp.sqrt(jnp.sum(diff * diff) / denom)  # :19-21
             return (U, V), err

@@ -52,6 +52,10 @@ class SSGDConfig:
     # touches only the minibatch's HBM bytes (≈1/frac less traffic), like
     # Spark's per-partition sampling it is shard-count dependent
     sampler: str = "bernoulli"
+    # shard the FEATURE dim over the mesh model axis (tensor parallelism):
+    # the forward matvec psums partial X_l·w_l over 'model', the gradient
+    # contraction psums over 'data' only, and w lives sharded P('model')
+    feature_sharded: bool = False
 
 
 @dataclasses.dataclass
@@ -95,6 +99,13 @@ def _build_scan(config: SSGDConfig, sample_and_grad):
 
 def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Build the jitted scan over ``n_iterations`` SSGD steps."""
+    if config.feature_sharded:
+        if config.sampler != "bernoulli" or config.use_pallas:
+            raise ValueError(
+                "feature_sharded composes with the 'bernoulli' sampler "
+                "and the XLA gradient path only"
+            )
+        return _make_train_fn_tp(mesh, config, n_padded)
     if config.sampler == "fixed":
         return _make_train_fn_fixed(mesh, config, n_padded)
     if config.sampler != "bernoulli":
@@ -122,6 +133,45 @@ def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
         out_specs=(P(), P()),
     )
     key = prng.root_key(config.seed)
+
+    def sample_and_grad(X, y, valid, w, t):
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid
+        )
+        return grad_fn(X, y, mask, w)
+
+    return _build_scan(config, sample_and_grad)
+
+
+def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
+    """dp×tp SSGD: rows sharded over 'data', features over 'model'.
+
+    Forward: z = psum_model(X_l·w_l) — a tensor-parallel matvec; backward:
+    g_l = psum_data(X_lᵀ·resid) — each model shard owns its feature slice
+    of the gradient and of w. Caller pads the feature dim to a multiple of
+    the model-axis size (zero columns are inert).
+    """
+    from jax import lax
+
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+
+    key = prng.root_key(config.seed)
+
+    def _local_grad(X, y, mask, w):
+        z = lax.psum(X @ w, MODEL_AXIS)            # (rows_l,) TP matvec
+        resid = (jax.nn.sigmoid(z) - y) * mask
+        g = lax.psum(X.T @ resid, DATA_AXIS)       # my feature slice
+        cnt = lax.psum(jnp.sum(mask), DATA_AXIS)
+        return g, cnt
+
+    grad_fn = data_parallel(
+        _local_grad,
+        mesh,
+        in_specs=(
+            P("data", "model"), P("data"), P("data"), P("model"),
+        ),
+        out_specs=(P("model"), P()),
+    )
 
     def sample_and_grad(X, y, valid, w, t):
         mask = sampling.bernoulli_mask(
@@ -188,19 +238,38 @@ def train(
     """
     import numpy as np
 
+    from tpu_distalg.parallel import MODEL_AXIS
+    from jax.sharding import NamedSharding
+
+    d_orig = X_train.shape[1]
+    n_model = mesh.shape[MODEL_AXIS]
+    if config.feature_sharded:
+        # zero feature columns are inert: zero grad slice, zero w slice
+        d_pad = (-d_orig) % n_model
+        if d_pad:
+            X_train = np.pad(np.asarray(X_train), ((0, 0), (0, d_pad)))
+            X_test = np.pad(np.asarray(X_test), ((0, 0), (0, d_pad)))
+
     Xs = parallelize(
         X_train, mesh, dtype=jnp.dtype(config.x_dtype)
     )
+    X_data = Xs.data
+    if config.feature_sharded:
+        X_data = jax.device_put(
+            X_data, NamedSharding(mesh, P("data", "model"))
+        )
     ys = parallelize(y_train, mesh)
     w0 = logistic.init_weights(
         prng.root_key(config.init_seed), X_train.shape[1]
     )
+    if config.feature_sharded:
+        w0 = jax.device_put(w0, NamedSharding(mesh, P("model")))
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
 
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config, Xs.n_padded)
-        w, accs = fn(Xs.data, ys.data, Xs.mask, X_te, y_te, w0)
-        return TrainResult(w=w, accs=accs)
+        w, accs = fn(X_data, ys.data, Xs.mask, X_te, y_te, w0)
+        return TrainResult(w=w[:d_orig], accs=accs)
 
     from tpu_distalg.utils import checkpoint as ckpt
 
@@ -228,7 +297,7 @@ def train(
                 Xs.n_padded,
             )
         w, accs = seg_fns[seg](
-            Xs.data, ys.data, Xs.mask, X_te, y_te, w, t0=t
+            X_data, ys.data, Xs.mask, X_te, y_te, w, t0=t
         )
         if not bool(jnp.all(jnp.isfinite(w))):
             raise FloatingPointError(
@@ -246,4 +315,4 @@ def train(
         ckpt.prune(checkpoint_dir, keep=3)
     all_accs = (jnp.concatenate([jnp.asarray(a) for a in accs_parts])
                 if accs_parts else jnp.zeros((0,)))
-    return TrainResult(w=w, accs=all_accs)
+    return TrainResult(w=w[:d_orig], accs=all_accs)

@@ -55,6 +55,29 @@ def draw_acc_plot(accs, path: str, alpha: float = 0.9, title: str =
     plt.close(fig)
 
 
+def display_clusters(points, assignments, path: str, k: int | None = None):
+    """2-D cluster scatter plot — the reference's ``display_clusters``
+    (``k-means.py:30-40``), with stable per-cluster colors instead of its
+    random hex strings."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    points = np.asarray(points)
+    assignments = np.asarray(assignments)
+    if points.shape[1] != 2:
+        raise ValueError("display_clusters draws 2-D points only")
+    k = k if k is not None else int(assignments.max()) + 1
+    fig, ax = plt.subplots()
+    for c in range(k):
+        sel = assignments == c
+        ax.scatter(points[sel, 0], points[sel, 1], s=12, label=f"c{c}")
+    ax.legend(loc="best", fontsize=8)
+    fig.savefig(path)
+    plt.close(fig)
+
+
 class StepTimer:
     """Wall-clock timer for XLA programs. Dispatch is async, so assign the
     program's output to ``.result`` inside the block — ``__exit__`` calls
