@@ -1,0 +1,54 @@
+"""Perf probe: compare SSGD step-path variants on the attached device.
+
+Prints steps/sec for each (sampler, dtype, kernel) combination at bench
+scale so we can pick the fastest faithful path for bench.py.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpu_distalg.models import ssgd
+from tpu_distalg.ops import logistic
+from tpu_distalg.parallel import get_mesh, parallelize
+from tpu_distalg.utils import datasets, prng
+
+N_ROWS = 1 << 20
+N_FEATURES = 128
+N_STEPS = 200
+
+
+def probe(name, config):
+    mesh = get_mesh()
+    X, y = datasets.synthetic_two_class(N_ROWS, N_FEATURES, seed=0)
+    X = datasets.add_bias_column(X)
+    Xs = parallelize(X, mesh, dtype=jnp.dtype(config.x_dtype))
+    ys = parallelize(y, mesh)
+    w0 = logistic.init_weights(prng.root_key(7), X.shape[1])
+    fn = ssgd.make_train_fn(mesh, config, Xs.n_padded)
+    X_ev = jnp.zeros((1, X.shape[1]), jnp.float32)
+    y_ev = jnp.zeros((1,), jnp.float32)
+    w, _ = fn(Xs.data, ys.data, Xs.mask, X_ev, y_ev, w0)
+    jax.block_until_ready(w)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w, _ = fn(Xs.data, ys.data, Xs.mask, X_ev, y_ev, w)
+        jax.block_until_ready(w)
+        best = max(best, N_STEPS / (time.perf_counter() - t0))
+    print(f"{name:30s} {best:10.1f} steps/s", flush=True)
+
+
+if __name__ == "__main__":
+    C = ssgd.SSGDConfig
+    probe("bernoulli f32", C(n_iterations=N_STEPS, eval_test=False))
+    probe("bernoulli bf16",
+          C(n_iterations=N_STEPS, eval_test=False, x_dtype="bfloat16"))
+    probe("pallas f32",
+          C(n_iterations=N_STEPS, eval_test=False, use_pallas=True))
+    probe("fixed f32",
+          C(n_iterations=N_STEPS, eval_test=False, sampler="fixed"))
+    probe("fixed bf16",
+          C(n_iterations=N_STEPS, eval_test=False, sampler="fixed",
+            x_dtype="bfloat16"))

@@ -55,3 +55,68 @@ def test_fused_grad_zero_mask():
                           interpret=True)
     assert float(c) == 0.0
     np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-6)
+
+
+# ---- packed one-pass kernel (v3): CPU-testable pieces ----
+# The kernel itself needs the TPU on-core PRNG (no interpret lowering);
+# its layout/packing/selector algebra is pure XLA and is verified here.
+
+from tpu_distalg.ops.pallas_kernels import build_selector, pack_augmented
+
+
+def test_pack_augmented_layout():
+    rng = np.random.default_rng(4)
+    n, d = 300, 13
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    X2, meta = pack_augmented(X, y, np.ones(n, np.float32),
+                              dtype=jnp.float32, pack=16, block_rows=128)
+    P, D = meta["pack"], meta["d_total"]
+    assert (P * D) % 128 == 0
+    assert meta["n_padded"] % 128 == 0
+    flat = np.asarray(X2).reshape(meta["n_padded"], D)
+    np.testing.assert_array_equal(flat[:n, :d], X)
+    np.testing.assert_array_equal(flat[:n, meta["y_col"]], y)
+    np.testing.assert_array_equal(flat[:n, meta["v_col"]], 1.0)
+    # padded rows are invalid
+    np.testing.assert_array_equal(flat[n:, meta["v_col"]], 0.0)
+
+
+def test_build_selector_algebra():
+    """x2 @ [Wbig|Ey|Ev] must reproduce (z, y, v) for every packed slot."""
+    rng = np.random.default_rng(5)
+    n, d = 64, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    X2, meta = pack_augmented(X, y, np.ones(n, np.float32),
+                              dtype=jnp.float32, pack=16, block_rows=64)
+    P, D = meta["pack"], meta["d_total"]
+    w = rng.normal(size=(d,)).astype(np.float32)
+    w_aug = np.zeros(D, np.float32)
+    w_aug[:d] = w
+    C = np.asarray(build_selector(
+        jnp.asarray(w_aug), pack=P, d_total=D, y_col=meta["y_col"],
+        v_col=meta["v_col"], dtype=jnp.float32))
+    zyv = np.asarray(X2) @ C                       # (n/P, 3P)
+    flat = np.asarray(X2).reshape(meta["n_padded"], D)
+    z_expect = flat @ w_aug
+    for r in range(zyv.shape[0]):
+        for c in range(P):
+            i = r * P + c
+            np.testing.assert_allclose(zyv[r, c], z_expect[i], rtol=1e-5)
+            assert zyv[r, P + c] == flat[i, meta["y_col"]]
+            assert zyv[r, 2 * P + c] == flat[i, meta["v_col"]]
+
+
+def test_fused_sampler_requires_tpu(mesh4):
+    """On a CPU mesh the 'fused' sampler must fail loudly, not wrongly."""
+    import pytest
+
+    from tpu_distalg.models import ssgd
+
+    X2, meta = pack_augmented(
+        np.zeros((64, 4), np.float32), np.zeros(64, np.float32),
+        np.ones(64, np.float32), pack=16, block_rows=64)
+    with pytest.raises(ValueError, match="TPU"):
+        ssgd.make_train_fn_fused(
+            mesh4, ssgd.SSGDConfig(sampler="fused"), meta)

@@ -208,9 +208,17 @@ def test_display_clusters_plot(mesh8, tmp_path):
 
 
 def test_als_model_axis_sharding(mesh_2x4):
-    """V sharded over the model axis (n=500 not divisible by 4 → falls
-    back; n=512 shards) — result must match the replicated path."""
+    """n=512 divides the 4-way model axis → V sharded P('model')."""
     cfg = als.ALSConfig(m=64, n=512, k=8, n_iterations=6, lam=0.0)
     res = als.fit(mesh_2x4, cfg)
     assert res.final_rmse < 1e-2
     assert res.V.shape == (512, 8)
+
+
+def test_als_model_axis_nondivisible_falls_back(mesh_2x4):
+    """n=500 does NOT divide the 4-way model axis: the v_sharding=None
+    fallback (replicated V) must still converge."""
+    cfg = als.ALSConfig(m=64, n=500, k=8, n_iterations=6, lam=0.0)
+    res = als.fit(mesh_2x4, cfg)
+    assert res.final_rmse < 1e-2
+    assert res.V.shape == (500, 8)

@@ -16,6 +16,7 @@ reference Bernoulli-samples a minibatch (``sample(False, 0.1, 42+t)``,
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -45,13 +46,18 @@ class SSGDConfig:
     eval_test: bool = True
     # TPU perf knobs (not in the reference):
     x_dtype: str = "float32"    # 'bfloat16' halves HBM traffic for X
-    use_pallas: bool = False    # fused one-pass gradient kernel
+    use_pallas: bool = False    # v1 fused one-pass kernel (interpretable)
     pallas_block_rows: int = 2048
     # 'bernoulli' = reference-parity mask over ALL rows (sample() semantics,
     # ssgd.py:97); 'fixed' = gather exactly frac·n_local rows per shard —
     # touches only the minibatch's HBM bytes (≈1/frac less traffic), like
-    # Spark's per-partition sampling it is shard-count dependent
+    # Spark's per-partition sampling it is shard-count dependent;
+    # 'fused' = TPU-only packed Pallas kernel: sampling + forward +
+    # backward in ONE HBM pass over X (fastest; Bernoulli semantics,
+    # shard/block-dependent mask like Spark's per-partition sample())
     sampler: str = "bernoulli"
+    fused_pack: int = 16        # rows packed per sublane row ('fused')
+    fused_block_rows: int = 8192
     # shard the FEATURE dim over the mesh model axis (tensor parallelism):
     # the forward matvec psums partial X_l·w_l over 'model', the gradient
     # contraction psums over 'data' only, and w lives sharded P('model')
@@ -99,6 +105,12 @@ def _build_scan(config: SSGDConfig, sample_and_grad):
 
 def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Build the jitted scan over ``n_iterations`` SSGD steps."""
+    if config.sampler == "fused":
+        raise ValueError(
+            "sampler='fused' packs labels into X — build via "
+            "make_train_fn_fused(mesh, config, meta) with meta from "
+            "pallas_kernels.pack_augmented, or use ssgd.train()"
+        )
     if config.feature_sharded:
         if config.sampler != "bernoulli" or config.use_pallas:
             raise ValueError(
@@ -182,6 +194,51 @@ def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, sample_and_grad)
 
 
+def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
+    """Scan builder for the 'fused' sampler: the packed one-pass Pallas
+    kernel (``pallas_kernels.fused_grad_sum_packed``) inside ``shard_map``
+    over the data axis; (Σg, count) psum'd across shards. The carried
+    weight vector is the augmented (d_total,) layout; the y/v/pad columns
+    are re-zeroed every step (their gradient entries are kernel garbage).
+    """
+    from jax import lax
+
+    from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import DATA_AXIS
+
+    if next(iter(mesh.devices.flat)).platform != "tpu":
+        raise ValueError(
+            "sampler='fused' needs a TPU (the on-core PRNG has no "
+            "interpret-mode lowering); use 'bernoulli' elsewhere"
+        )
+    d_t = meta["d_total"]
+    col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(jnp.float32)
+    kern = functools.partial(
+        pallas_kernels.fused_grad_sum_packed,
+        pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+        v_col=meta["v_col"], fraction=config.mini_batch_fraction,
+        block_rows=config.fused_block_rows,
+    )
+
+    def _local_grad(X2, w, t):
+        shard = lax.axis_index(DATA_AXIS)
+        g, cnt = kern(X2, w, t + config.seed, shard)
+        return tree_allreduce_sum((g * col_keep, cnt))
+
+    grad_fn = data_parallel(
+        _local_grad,
+        mesh,
+        in_specs=(P("data", None), P(), P()),
+        out_specs=(P(), P()),
+    )
+
+    def sample_and_grad(X2, y, valid, w, t):
+        del y, valid  # labels/validity ride inside the packed X2
+        return grad_fn(X2, w, t)
+
+    return _build_scan(config, sample_and_grad)
+
+
 def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Fixed-size per-shard gather sampling: each shard draws exactly
     ``frac·n_local`` local row indices per step and gathers only those rows
@@ -238,8 +295,15 @@ def train(
     """
     import numpy as np
 
-    from tpu_distalg.parallel import MODEL_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
     from jax.sharding import NamedSharding
+
+    if config.sampler == "fused":
+        return _train_fused(
+            X_train, y_train, X_test, y_test, mesh, config,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
 
     d_orig = X_train.shape[1]
     n_model = mesh.shape[MODEL_AXIS]
@@ -316,3 +380,68 @@ def train(
     all_accs = (jnp.concatenate([jnp.asarray(a) for a in accs_parts])
                 if accs_parts else jnp.zeros((0,)))
     return TrainResult(w=w[:d_orig], accs=all_accs)
+
+
+def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
+    """One-time setup shared by :func:`_train_fused` and ``bench.py``:
+    pack (X, y, validity) into the fused kernel's layout, shard it over
+    the data axis, build the augmented initial weights and the jitted
+    scan. Returns ``(fn, X2, w0, meta)``; call as
+    ``fn(X2, dummy, dummy, X_test_padded, y_test, w0)``.
+    """
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import DATA_AXIS
+
+    n_shards = mesh.shape[DATA_AXIS]
+    d_orig = X_train.shape[1]
+    n = X_train.shape[0]
+    X2, meta = pallas_kernels.pack_augmented(
+        np.asarray(X_train), np.asarray(y_train), np.ones(n, np.float32),
+        dtype=jnp.dtype(config.x_dtype),
+        pack=config.fused_pack,
+        block_rows=config.fused_block_rows * n_shards,
+    )
+    X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
+    w0 = jnp.zeros((meta["d_total"],), jnp.float32).at[:d_orig].set(
+        logistic.init_weights(prng.root_key(config.init_seed), d_orig)
+    )
+    fn = make_train_fn_fused(mesh, config, meta)
+    return fn, X2, w0, meta
+
+
+def _train_fused(
+    X_train, y_train, X_test, y_test, mesh: Mesh, config: SSGDConfig,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
+) -> TrainResult:
+    """'fused'-sampler training: pack once, stream the packed matrix.
+
+    The packed layout bakes labels and row validity into X
+    (``pallas_kernels.pack_augmented``), so the scan carries an augmented
+    (d_total,) weight vector; eval pads X_test with matching zero columns
+    (the y/v entries of w are held at zero each step, so the padded
+    matvec equals the unpadded one).
+    """
+    import numpy as np
+
+    if checkpoint_dir is not None:
+        raise NotImplementedError(
+            "checkpointing composes with the XLA samplers; run "
+            "sampler='fused' without checkpoint_dir (its packed state "
+            "is a pure function of the inputs)"
+        )
+    d_orig = X_train.shape[1]
+    fn, X2, w0, meta = prepare_fused(X_train, y_train, mesh, config)
+    X_te = jnp.asarray(
+        np.pad(np.asarray(X_test, np.float32),
+               ((0, 0), (0, meta["d_total"] - d_orig)))
+    )
+    y_te = jnp.asarray(y_test)
+    dummy = jnp.zeros((1,), jnp.float32)
+    w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
+    return TrainResult(w=w[:d_orig], accs=accs)
