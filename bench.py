@@ -1,22 +1,36 @@
-"""Benchmark driver — prints ONE JSON line.
+"""Benchmark driver — prints ONE JSON line per metric (SSGD first).
 
-Metric: SSGD logistic-regression steps/sec/chip (BASELINE.json) on a
-1M-row synthetic two-class task (125 features + bias; with the packed
-label/validity columns the design matrix is exactly 128-wide — one lane
-tile), minibatch fraction 0.1 — the reference's ``optimization/ssgd.py``
-schedule at benchmark scale.
+Metrics (BASELINE.json):
+  1. SSGD logistic-regression steps/sec/chip on a 1M-row synthetic
+     two-class task (125 features + bias; with the packed label/validity
+     columns the design matrix is exactly 128 wide — one lane tile),
+     minibatch fraction 0.1 — the reference's ``optimization/ssgd.py``
+     schedule at benchmark scale.
+  2. PageRank iterations/sec on a 1M-vertex, ~8M-edge Erdős–Rényi graph
+     (``graph_computation/pagerank.py:50-57`` at benchmark scale).
 
-On TPU the step runs the packed one-pass Pallas kernel
-(``sampler='fused'``: sampling + forward + backward in a single HBM pass
-over X, bf16); elsewhere it falls back to the XLA Bernoulli-mask path so
-the bench still runs on CPU meshes.
+On TPU the SSGD step runs the traffic-proportional block-gather Pallas
+kernel (``sampler='fused_gather'``: per step, sample frac·n_blocks block
+ids XLA-side and DMA ONLY those blocks — HBM traffic ≈ fraction × |X|);
+elsewhere it falls back to the XLA Bernoulli-mask path so the bench still
+runs on CPU meshes. Steps are timed over ``N_STEPS``-long jitted scans —
+the reference's whole-schedule-in-one-program shape — so per-call
+dispatch overhead (large on tunneled TPU rigs) is amortized exactly the
+way a real training run amortizes it.
 
 Baseline: the reference launches one Spark job per SGD step
-(``ssgd.py:93-103``); PySpark is not installed in this image (no JVM), so
-the baseline is a *generous* estimate of local-mode Spark job throughput:
-BASELINE_STEPS_PER_SEC = 20 jobs/sec (50 ms/job scheduling+pickling floor;
-real local[*] measurements are typically 10-30 jobs/sec for trivial jobs,
-and far worse at 1M rows). ``vs_baseline`` = our steps/sec ÷ that.
+(``ssgd.py:93-103``). PySpark is not installable here (no JVM), so the
+baseline is MEASURED as the same SSGD update executed in the reference's
+driver-loop shape — one jit call + host round-trip per step, no scan —
+which is the per-step dispatch pattern Spark's driver pays before any of
+its scheduling/pickling/shuffle costs. ``vs_baseline`` divides by
+max(measured, 20.0 assumed Spark jobs/s) so a slow rig can only make the
+claim more conservative, never less.
+
+Convergence evidence (recorded every round): the breast-cancer task is
+trained to 1500 iterations with each fused kernel and the final test
+accuracy is emitted in the SSGD JSON line (reference golden 0.929825,
+``ssgd.py:130``).
 """
 
 import json
@@ -26,9 +40,14 @@ import time
 
 N_ROWS = 1 << 20
 N_FEATURES = 125
-N_STEPS = 200  # steps per timed scan segment
+N_STEPS = 1500          # steps per timed scan segment (reference schedule)
 N_REPEATS = 3
-BASELINE_STEPS_PER_SEC = 20.0
+GATHER_BLOCK_ROWS = 8192
+ASSUMED_SPARK_JOBS_PER_SEC = 20.0
+PR_VERTICES = 1_000_000
+PR_AVG_DEGREE = 8.0
+PR_ITERS_PER_CALL = 50
+V5E_HBM_BYTES_PER_SEC = 819e9
 WATCHDOG_SECONDS = int(os.environ.get("BENCH_WATCHDOG_SECONDS", 1800))
 
 
@@ -45,20 +64,15 @@ def _watchdog():
     os._exit(2)
 
 
-def main():
-    threading.Thread(target=_watchdog, daemon=True).start()
+def _bench_ssgd(mesh, on_tpu, n_chips):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from tpu_distalg.models import ssgd
     from tpu_distalg.ops import logistic
-    from tpu_distalg.parallel import get_mesh, parallelize
+    from tpu_distalg.parallel import parallelize
     from tpu_distalg.utils import datasets, prng
-
-    mesh = get_mesh()
-    n_chips = len(jax.devices())
-    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
 
     X, y = datasets.synthetic_two_class(N_ROWS, N_FEATURES, seed=0)
     X = datasets.add_bias_column(X)
@@ -67,20 +81,31 @@ def main():
     if on_tpu:
         config = ssgd.SSGDConfig(
             n_iterations=N_STEPS, eval_test=False,
-            x_dtype="bfloat16", sampler="fused", init_seed=7,
+            x_dtype="bfloat16", sampler="fused_gather",
+            gather_block_rows=GATHER_BLOCK_ROWS, shuffle_seed=0,
+            init_seed=7,
         )
         fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh, config)
         dummy = jnp.zeros((1,), jnp.float32)
         ev = (jnp.zeros((1, meta["d_total"]), jnp.float32),
               jnp.zeros((1,), jnp.float32))
         args = (X2, dummy, dummy, ev[0], ev[1])
+        # mirror make_train_fn_fused: each shard samples
+        # max(1, round(frac·n_blocks_local)) blocks independently
+        n_shards = int(mesh.shape["data"])
+        n_blocks_local = (meta["n_padded"] // n_shards) // GATHER_BLOCK_ROWS
+        n_sampled_local = max(
+            1, round(config.mini_batch_fraction * n_blocks_local))
+        bytes_per_step = (n_sampled_local * n_shards * GATHER_BLOCK_ROWS
+                          * int(meta["d_total"]) * 2)  # bf16
     else:
         config = ssgd.SSGDConfig(n_iterations=N_STEPS, eval_test=False)
         Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
         w0 = logistic.init_weights(prng.root_key(7), d)
         fn = ssgd.make_train_fn(mesh, config, Xs.n_padded)
-        ev = jnp.zeros((1, d), jnp.float32), jnp.zeros((1,), jnp.float32)
+        ev = (jnp.zeros((1, d), jnp.float32), jnp.zeros((1,), jnp.float32))
         args = (Xs.data, ys.data, Xs.mask, ev[0], ev[1])
+        bytes_per_step = Xs.n_padded * d * 4 * 2  # f32, fwd+bwd passes
 
     def run(w):
         # NOTE: device timing via host fetch — on tunneled TPU backends
@@ -91,19 +116,121 @@ def main():
 
     w = run(w0)  # warmup / compile
     best = 0.0
-    for r in range(N_REPEATS):
+    for _ in range(N_REPEATS):
         t0 = time.perf_counter()
         w = run(w)
         dt = time.perf_counter() - t0
         best = max(best, N_STEPS / dt)
-
     per_chip = best / n_chips
+
+    # measured baseline stand-in: identical update, driver-loop shape —
+    # one jit dispatch + host round-trip per step (the reference's
+    # job-per-step pattern, ssgd.py:93-103, minus all Spark overheads)
+    one_cfg = ssgd.SSGDConfig(n_iterations=1, eval_test=False)
+    if on_tpu:
+        one_cfg = ssgd.SSGDConfig(
+            n_iterations=1, eval_test=False, x_dtype="bfloat16",
+            sampler="fused_gather", gather_block_rows=GATHER_BLOCK_ROWS,
+            shuffle_seed=0, init_seed=7)
+        one_fn = ssgd.make_train_fn_fused(mesh, one_cfg, meta)
+    else:
+        one_fn = ssgd.make_train_fn(mesh, one_cfg, Xs.n_padded)
+    wb = np.asarray(one_fn(*args, w0, 0)[0])  # compile
+    n_base = 20
+    t0 = time.perf_counter()
+    for t in range(n_base):
+        wb = np.asarray(one_fn(*args, jnp.asarray(wb), t)[0])
+    measured_baseline = n_base / (time.perf_counter() - t0)
+    denom = max(measured_baseline, ASSUMED_SPARK_JOBS_PER_SEC)
+
+    # convergence evidence on the reference task (TPU kernels only)
+    conv = {}
+    if on_tpu:
+        data = datasets.breast_cancer_split()
+        conv["convergence_acc_fused"] = round(ssgd.train(
+            *data, mesh,
+            ssgd.SSGDConfig(n_iterations=1500, sampler="fused"),
+        ).final_acc, 6)
+        conv["convergence_acc_fused_gather"] = round(ssgd.train(
+            *data, mesh,
+            ssgd.SSGDConfig(n_iterations=1500, sampler="fused_gather",
+                            fused_pack=4, gather_block_rows=32,
+                            shuffle_seed=0),
+        ).final_acc, 6)
+
     print(json.dumps({
         "metric": "ssgd_lr_steps_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "steps/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_STEPS_PER_SEC, 2),
-    }))
+        "vs_baseline": round(per_chip / denom, 2),
+        "sampler": config.sampler,
+        "x_dtype": config.x_dtype,
+        "n_rows": N_ROWS,
+        "n_features": N_FEATURES,
+        "steps_per_segment": N_STEPS,
+        "bytes_per_step": bytes_per_step,
+        "hbm_peak_fraction": round(
+            bytes_per_step * per_chip / V5E_HBM_BYTES_PER_SEC, 4),
+        "baseline_steps_per_sec_measured": round(measured_baseline, 2),
+        "baseline_method": (
+            "jit-per-step host-roundtrip loop (measured); "
+            f"vs_baseline uses max(measured, {ASSUMED_SPARK_JOBS_PER_SEC}"
+            " assumed Spark local[*] jobs/s)"),
+        **conv,
+    }), flush=True)
+
+
+def _bench_pagerank(mesh, n_chips):
+    import numpy as np
+
+    from tpu_distalg.models import pagerank
+    from tpu_distalg.ops import graph as gops
+    from tpu_distalg.utils import datasets
+
+    edges = datasets.erdos_renyi_edges(PR_VERTICES, PR_AVG_DEGREE, seed=0)
+    el = gops.prepare_edges(edges, PR_VERTICES)
+    de = pagerank.prepare_device_edges(el, mesh)
+
+    cfg = pagerank.PageRankConfig(
+        n_iterations=PR_ITERS_PER_CALL, mode="standard")
+    fn = pagerank.make_run_fn(mesh, cfg, de.n_vertices)
+
+    def run():
+        ranks, _ = fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
+                      de.n_ref)
+        np.asarray(ranks)
+
+    run()  # warmup / compile
+    best = 0.0
+    for _ in range(N_REPEATS):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        best = max(best, PR_ITERS_PER_CALL / dt)
+    print(json.dumps({
+        "metric": "pagerank_1m_iters_per_sec",
+        "value": round(best / n_chips, 3),
+        "unit": "iter/s/chip",
+        "vs_baseline": None,
+        "n_vertices": PR_VERTICES,
+        "n_edges": int(el.n_edges),
+        "mode": "standard",
+        "iters_per_call": PR_ITERS_PER_CALL,
+    }), flush=True)
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+    import jax
+
+    from tpu_distalg.parallel import get_mesh
+
+    mesh = get_mesh()
+    n_chips = len(jax.devices())
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+
+    _bench_ssgd(mesh, on_tpu, n_chips)
+    _bench_pagerank(mesh, n_chips)
 
 
 if __name__ == "__main__":

@@ -1,7 +1,8 @@
 """Perf probe: compare SSGD step-path variants on the attached device.
 
 Prints steps/sec for each (sampler, dtype, kernel) combination at bench
-scale so we can pick the fastest faithful path for bench.py.
+scale (same workload as bench.py: 1M rows, 125 features + bias → 128-wide
+packed matrix) so we can pick the fastest faithful path for bench.py.
 """
 
 import time
@@ -15,28 +16,53 @@ from tpu_distalg.parallel import get_mesh, parallelize
 from tpu_distalg.utils import datasets, prng
 
 N_ROWS = 1 << 20
-N_FEATURES = 128
+N_FEATURES = 125  # +bias = 126; packed layout pads to 128 (bench.py)
 N_STEPS = 200
+
+
+def _data():
+    X, y = datasets.synthetic_two_class(N_ROWS, N_FEATURES, seed=0)
+    return datasets.add_bias_column(X), y
+
+
+def _time(run, w0):
+    w = run(w0)  # warmup / compile
+    jax.block_until_ready(w)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w = run(w)
+        jax.block_until_ready(w)
+        best = max(best, N_STEPS / (time.perf_counter() - t0))
+    return best
 
 
 def probe(name, config):
     mesh = get_mesh()
-    X, y = datasets.synthetic_two_class(N_ROWS, N_FEATURES, seed=0)
-    X = datasets.add_bias_column(X)
+    X, y = _data()
     Xs = parallelize(X, mesh, dtype=jnp.dtype(config.x_dtype))
     ys = parallelize(y, mesh)
     w0 = logistic.init_weights(prng.root_key(7), X.shape[1])
     fn = ssgd.make_train_fn(mesh, config, Xs.n_padded)
     X_ev = jnp.zeros((1, X.shape[1]), jnp.float32)
     y_ev = jnp.zeros((1,), jnp.float32)
-    w, _ = fn(Xs.data, ys.data, Xs.mask, X_ev, y_ev, w0)
-    jax.block_until_ready(w)
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        w, _ = fn(Xs.data, ys.data, Xs.mask, X_ev, y_ev, w)
-        jax.block_until_ready(w)
-        best = max(best, N_STEPS / (time.perf_counter() - t0))
+    best = _time(lambda w: fn(Xs.data, ys.data, Xs.mask, X_ev, y_ev, w)[0],
+                 w0)
+    print(f"{name:30s} {best:10.1f} steps/s", flush=True)
+
+
+def probe_fused(name, config):
+    """Fused-sampler probe via ssgd.prepare_fused (the bench.py path)."""
+    mesh = get_mesh()
+    if next(iter(mesh.devices.flat)).platform != "tpu":
+        print(f"{name:30s}       skip (needs TPU)", flush=True)
+        return
+    X, y = _data()
+    fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh, config)
+    dummy = jnp.zeros((1,), jnp.float32)
+    ev = (jnp.zeros((1, meta["d_total"]), jnp.float32),
+          jnp.zeros((1,), jnp.float32))
+    best = _time(lambda w: fn(X2, dummy, dummy, ev[0], ev[1], w)[0], w0)
     print(f"{name:30s} {best:10.1f} steps/s", flush=True)
 
 
@@ -52,3 +78,6 @@ if __name__ == "__main__":
     probe("fixed bf16",
           C(n_iterations=N_STEPS, eval_test=False, sampler="fixed",
             x_dtype="bfloat16"))
+    probe_fused("fused bf16",
+                C(n_iterations=N_STEPS, eval_test=False, sampler="fused",
+                  x_dtype="bfloat16", init_seed=7))

@@ -4,6 +4,8 @@ on breast-cancer 70/30. Our runs use different (seeded) inits and f32, so we
 assert convergence into the same quality band rather than bit equality.
 """
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -99,6 +101,26 @@ def test_ssgd_fixed_sampler(mesh8, cancer_data):
         ssgd.SSGDConfig(n_iterations=1500, sampler="fixed"),
     )
     assert res.final_acc >= 0.88, res.final_acc
+
+
+def test_ssgd_fused_gather_sampler(mesh4, cancer_data):
+    """The traffic-proportional gathered kernel end-to-end on the CPU mesh
+    (interpret mode — same code path that compiles to Mosaic on TPU).
+    Short run: interpret-mode pallas is slow; convergence-to-golden is
+    asserted on TPU (test_tpu_numerics.py) and recorded by bench.py."""
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(
+        n_iterations=400, sampler="fused_gather", fused_pack=4,
+        gather_block_rows=32, shuffle_seed=0)
+    res = ssgd.train(X_train, y_train, X_test, y_test, mesh4, cfg)
+    assert np.all(np.isfinite(np.asarray(res.w)))
+    assert res.w.shape == (31,)
+    assert res.final_acc >= 0.8, res.final_acc
+    # deterministic: same seeds → bitwise-equal weights
+    cfg2 = dataclasses.replace(cfg, n_iterations=40)
+    ra = ssgd.train(X_train, y_train, X_test, y_test, mesh4, cfg2)
+    rb = ssgd.train(X_train, y_train, X_test, y_test, mesh4, cfg2)
+    np.testing.assert_array_equal(np.asarray(ra.w), np.asarray(rb.w))
 
 
 def test_ssgd_feature_sharded_matches_dp(mesh_2x4, mesh1, cancer_data):

@@ -1,0 +1,124 @@
+"""On-TPU numerical validation of the fused Pallas kernels.
+
+The CPU suite verifies packing layout, selector algebra and the gathered
+kernel end-to-end in interpret mode; what it cannot verify is the v3
+kernel's on-core PRNG path and real-Mosaic convergence. These tests close
+that gap against the reference goldens (``/root/reference/optimization/
+ssgd.py:122-130``, final acc 0.929825).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_distalg.models import ssgd
+from tpu_distalg.ops import logistic
+from tpu_distalg.ops import pallas_kernels as pk
+from tpu_distalg.utils import datasets, prng
+
+
+def test_fused_v3_convergence(tpu_mesh, cancer_data):
+    """sampler='fused' (on-core-PRNG streaming kernel) reaches the
+    reference's SSGD quality band on breast-cancer."""
+    res = ssgd.train(
+        *cancer_data, tpu_mesh,
+        ssgd.SSGDConfig(n_iterations=1500, sampler="fused"),
+    )
+    assert res.final_acc >= 0.92, res.final_acc
+
+
+def test_fused_gather_convergence(tpu_mesh, cancer_data):
+    """sampler='fused_gather' (block-gather kernel) reaches the same
+    band; fine-grained blocks so the 398-row task has real stochasticity."""
+    res = ssgd.train(
+        *cancer_data, tpu_mesh,
+        ssgd.SSGDConfig(n_iterations=1500, sampler="fused_gather",
+                        fused_pack=4, gather_block_rows=32,
+                        shuffle_seed=0),
+    )
+    assert res.final_acc >= 0.92, res.final_acc
+
+
+def test_fused_v3_gradient_expectation(tpu_mesh):
+    """The v3 kernel's on-core-PRNG Bernoulli gradient is an unbiased
+    estimator: the mean normalized gradient over many steps must match
+    the full-batch mean gradient within standard-error tolerance (the
+    XLA path and the kernel use different PRNGs, so compare in
+    expectation, not per-draw)."""
+    rng = np.random.default_rng(0)
+    n, d = 1 << 16, 30
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    X2, meta = pk.pack_augmented(X, y, np.ones(n, np.float32),
+                                 dtype=jnp.float32, pack=16,
+                                 block_rows=8192)
+    w = np.zeros(meta["d_total"], np.float32)
+    w[:d] = rng.normal(size=(d,)).astype(np.float32) * 0.1
+    w_j = jnp.asarray(w)
+    T = 800
+    kern = functools.partial(
+        pk.fused_grad_sum_packed, pack=16, d_total=meta["d_total"],
+        y_col=meta["y_col"], v_col=meta["v_col"], fraction=0.1,
+        block_rows=8192)
+
+    @jax.jit
+    def mean_grad():
+        def step(acc, t):
+            g, cnt = kern(X2, w_j, t, 0)
+            return acc + g / jnp.maximum(cnt, 1.0), ()
+        acc, _ = jax.lax.scan(step, jnp.zeros((meta["d_total"],)),
+                              jnp.arange(T))
+        return acc / T
+
+    gm = np.asarray(mean_grad())[:d]
+    g_full, cnt = logistic.grad_sum(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(w[:d]),
+        jnp.ones(n))
+    gf = np.asarray(g_full / cnt)
+    # std-err of the mean-of-means ≈ σ_row/√(batch·T); bound generously
+    se = float(np.std(X) * 0.5 / np.sqrt(0.1 * n * T))
+    np.testing.assert_allclose(gm, gf, atol=20 * se)
+
+
+def test_fused_gather_gradient_expectation(tpu_mesh):
+    """Same unbiasedness check for the v4 block-gather kernel (block-
+    cluster sampling over i.i.d. rows)."""
+    rng = np.random.default_rng(1)
+    n, d = 1 << 16, 30
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    X2, meta = pk.pack_augmented(X, y, np.ones(n, np.float32),
+                                 dtype=jnp.float32, pack=16,
+                                 block_rows=1024)
+    w = np.zeros(meta["d_total"], np.float32)
+    w[:d] = rng.normal(size=(d,)).astype(np.float32) * 0.1
+    w_j = jnp.asarray(w)
+    n_blocks = meta["n_padded"] // 1024
+    n_sampled = max(1, round(0.1 * n_blocks))
+    T = 800
+    key = prng.root_key(0)
+    kern = functools.partial(
+        pk.fused_grad_sum_gathered, pack=16, d_total=meta["d_total"],
+        y_col=meta["y_col"], v_col=meta["v_col"], gather_block_rows=1024)
+
+    @jax.jit
+    def mean_grad():
+        keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(
+            jnp.arange(T))
+        bits = jax.vmap(lambda k: jax.random.bits(k, (n_blocks,)))(keys)
+        idx = jnp.argsort(bits, axis=-1)[:, :n_sampled].astype(jnp.int32)
+
+        def step(acc, ix):
+            g, cnt = kern(X2, w_j, ix)
+            return acc + g / jnp.maximum(cnt, 1.0), ()
+        acc, _ = jax.lax.scan(step, jnp.zeros((meta["d_total"],)), idx)
+        return acc / T
+
+    gm = np.asarray(mean_grad())[:d]
+    g_full, cnt = logistic.grad_sum(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(w[:d]), jnp.ones(n))
+    gf = np.asarray(g_full / cnt)
+    se = float(np.std(X) * 0.5 / np.sqrt(0.1 * n * T))
+    np.testing.assert_allclose(gm, gf, atol=20 * se)

@@ -8,6 +8,18 @@ gathers ``ranks[src]``, scatters contributions into a dense rank vector via
 a single ``lax.scan``; the reference executes them as one 10-join-deep lazy
 lineage at collect time (SURVEY.md §3.4).
 
+TPU layout decisions (random HBM access is the enemy — a random 8M-element
+gather costs ~60 ms on one v5e chip, an unsorted scatter more):
+
+  * edges are sorted by ``dst`` ONCE at prep, so the contribution scatter
+    is a ``segment_sum(indices_are_sorted=True)`` (sequential writes);
+    shards are contiguous slices of the sorted list, so per-shard
+    sortedness survives sharding, and padding uses dst=V-1 (order-
+    preserving, masked out);
+  * ``inv_deg[src]`` never changes across iterations, so it is gathered
+    once at prep into a static per-edge weight array — one random gather
+    per iteration (``ranks[src]``) instead of three.
+
 Two modes (SURVEY.md §7 hard part #6):
   * ``mode='reference'`` reproduces the reference's semantics exactly: n is
     the number of vertices WITH out-links (``:41-44``), sink vertices keep
@@ -32,7 +44,6 @@ from tpu_distalg.parallel import (
     DATA_AXIS,
     data_parallel,
     data_sharding,
-    pad_rows,
     tree_allreduce_sum,
 )
 
@@ -53,39 +64,77 @@ class PageRankResult:
     has_rank: jax.Array   # (V,) bool: vertex holds a rank (reference mode)
 
 
-def _local_sweep(src, dst, emask, ranks, inv_deg, has_rank, n_vertices):
-    """Per-shard contribution scatter + cross-shard combine."""
-    active = emask * has_rank[src]
-    per_edge = ranks[src] * inv_deg[src] * active
-    c = gops.scatter_add(per_edge, dst, n_vertices)
-    received = gops.scatter_add(active, dst, n_vertices)
-    return tree_allreduce_sum((c, received))
+@dataclasses.dataclass
+class DeviceEdges:
+    """dst-sorted, mesh-sharded edge arrays + static per-edge weights."""
+
+    src: jax.Array     # (E_pad,) int32, shards are dst-sorted slices
+    dst: jax.Array     # (E_pad,) int32
+    w_e: jax.Array     # (E_pad,) f32: inv_deg[src], 0 on padding
+    emask: jax.Array   # (E_pad,) f32 edge validity
+    inv_deg: jax.Array  # (V,) f32 (kept for parity introspection)
+    has_out: jax.Array  # (V,) f32
+    n_vertices: int
+    n_ref: float        # reference's n = #vertices with out-links (:41-44)
+
+
+def prepare_device_edges(el: gops.EdgeList, mesh: Mesh) -> DeviceEdges:
+    """One-time host prep: dst-sort, per-edge weight gather, pad, shard."""
+    order = np.argsort(el.dst, kind="stable")
+    src_o = el.src[order].astype(np.int32)
+    dst_o = el.dst[order].astype(np.int32)
+    deg = el.out_degree.astype(np.float32)
+    inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1.0), 0.0).astype(
+        np.float32
+    )
+    w_e = inv_deg[src_o]
+    V = el.n_vertices
+    n_shards = mesh.shape[DATA_AXIS]
+    E = len(src_o)
+    n_pad = (-E) % n_shards
+    # padding keeps dst sorted (dst=V-1 ≥ every real id) and carries zero
+    # weight/mask, so sorted-segment-sum sees it as an inert tail
+    src_p = np.concatenate([src_o, np.zeros(n_pad, np.int32)])
+    dst_p = np.concatenate([dst_o, np.full(n_pad, V - 1, np.int32)])
+    w_p = np.concatenate([w_e, np.zeros(n_pad, np.float32)])
+    emask = np.ones(E + n_pad, np.float32)
+    emask[E:] = 0.0
+    shard1 = data_sharding(mesh, 1)
+    put = lambda a: jax.device_put(jnp.asarray(a), shard1)  # noqa: E731
+    has_out = (deg > 0).astype(np.float32)
+    return DeviceEdges(
+        src=put(src_p), dst=put(dst_p), w_e=put(w_p), emask=put(emask),
+        inv_deg=jnp.asarray(inv_deg), has_out=jnp.asarray(has_out),
+        n_vertices=V, n_ref=float(has_out.sum()),
+    )
 
 
 def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
-    def body(src, dst, emask, ranks, inv_deg, has_rank):
-        return _local_sweep(
-            src, dst, emask, ranks, inv_deg, has_rank, n_vertices
+    V = n_vertices
+    q = config.q
+
+    if config.mode == "reference":
+        def body(src, dst, w_e, emask, ranks, has_rank):
+            active = emask * has_rank[src]
+            c = gops.contribs(ranks, src, dst, w_e * active,
+                              V, indices_sorted=True)
+            received = gops.scatter_add(active, dst, V,
+                                        indices_sorted=True)
+            return tree_allreduce_sum((c, received))
+
+        sweep_fn = data_parallel(
+            body, mesh,
+            in_specs=(P("data"),) * 4 + (P(), P()),
+            out_specs=(P(), P()),
         )
 
-    sweep_fn = data_parallel(
-        body,
-        mesh,
-        in_specs=(P("data"), P("data"), P("data"), P(), P(), P()),
-        out_specs=(P(), P()),
-    )
-
-    def run(src, dst, emask, inv_deg, has_out, n_ref):
-        q = config.q
-        if config.mode == "reference":
+        def run(src, dst, w_e, emask, has_out, n_ref):
             ranks0 = jnp.where(has_out > 0, 1.0 / n_ref, 0.0)  # :47
-            has_rank0 = has_out
 
             def step(carry, _):
                 ranks, has_rank = carry
-                c, received = sweep_fn(
-                    src, dst, emask, ranks, inv_deg, has_rank
-                )
+                c, received = sweep_fn(src, dst, w_e, emask, ranks,
+                                       has_rank)
                 new_has = (received > 0).astype(jnp.float32)
                 ranks = jnp.where(
                     received > 0, q / n_ref + (1 - q) * c, 0.0
@@ -93,18 +142,31 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
                 return (ranks, new_has), None
 
             (ranks, has_rank), _ = jax.lax.scan(
-                step, (ranks0, has_rank0), None,
+                step, (ranks0, has_out), None,
                 length=config.n_iterations,
             )
             return ranks, has_rank
 
-        # standard mode: every vertex ranked, Σranks preserved
-        V = n_vertices
+        return jax.jit(run)
+
+    # standard mode: every vertex ranked, Σranks preserved; one gather +
+    # one sorted scatter per iteration
+    def body(src, dst, w_e, ranks):
+        c = gops.contribs(ranks, src, dst, w_e, V, indices_sorted=True)
+        return tree_allreduce_sum(c)
+
+    sweep_fn = data_parallel(
+        body, mesh,
+        in_specs=(P("data"),) * 3 + (P(),),
+        out_specs=P(),
+    )
+
+    def run(src, dst, w_e, emask, has_out, n_ref):
+        del emask, n_ref  # padding already carries zero weight
         ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
-        all_ranked = jnp.ones((V,), dtype=jnp.float32)
 
         def step(ranks, _):
-            c, _ = sweep_fn(src, dst, emask, ranks, inv_deg, all_ranked)
+            c = sweep_fn(src, dst, w_e, ranks)
             if config.redistribute_dangling:
                 dangling = jnp.sum(ranks * (1.0 - has_out))
                 c = c + dangling / V
@@ -114,7 +176,7 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
         ranks, _ = jax.lax.scan(
             step, ranks0, None, length=config.n_iterations
         )
-        return ranks, all_ranked
+        return ranks, jnp.ones((V,), dtype=jnp.float32)
 
     return jax.jit(run)
 
@@ -123,24 +185,9 @@ def run(edges: np.ndarray, mesh: Mesh,
         config: PageRankConfig = PageRankConfig(),
         n_vertices: int | None = None) -> PageRankResult:
     el = gops.prepare_edges(edges, n_vertices)
-    n_shards = mesh.shape[DATA_AXIS]
-    V = el.n_vertices
-
-    ev = np.stack([el.src, el.dst], axis=1)
-    ev_padded, emask = pad_rows(ev, n_shards)
-    shard1 = data_sharding(mesh, 1)
-    src = jax.device_put(jnp.asarray(ev_padded[:, 0]), shard1)
-    dst = jax.device_put(jnp.asarray(ev_padded[:, 1]), shard1)
-    emask_d = jax.device_put(jnp.asarray(emask), shard1)
-
-    deg = el.out_degree.astype(np.float32)
-    inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1.0), 0.0)
-    has_out = (deg > 0).astype(np.float32)
-    n_ref = float(has_out.sum())  # n_vertexes = count with out-links (:41-44)
-
-    fn = make_run_fn(mesh, config, V)
+    de = prepare_device_edges(el, mesh)
+    fn = make_run_fn(mesh, config, de.n_vertices)
     ranks, has_rank = fn(
-        src, dst, emask_d,
-        jnp.asarray(inv_deg), jnp.asarray(has_out), n_ref,
+        de.src, de.dst, de.w_e, de.emask, de.has_out, de.n_ref
     )
     return PageRankResult(ranks=ranks, has_rank=has_rank)

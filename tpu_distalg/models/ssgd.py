@@ -50,14 +50,19 @@ class SSGDConfig:
     pallas_block_rows: int = 2048
     # 'bernoulli' = reference-parity mask over ALL rows (sample() semantics,
     # ssgd.py:97); 'fixed' = gather exactly frac·n_local rows per shard —
-    # touches only the minibatch's HBM bytes (≈1/frac less traffic), like
-    # Spark's per-partition sampling it is shard-count dependent;
+    # row-granular HBM gathers, measured SLOWER than streaming on TPU;
     # 'fused' = TPU-only packed Pallas kernel: sampling + forward +
-    # backward in ONE HBM pass over X (fastest; Bernoulli semantics,
-    # shard/block-dependent mask like Spark's per-partition sample())
+    # backward in ONE HBM pass over ALL of X (Bernoulli semantics,
+    # shard/block-dependent mask like Spark's per-partition sample());
+    # 'fused_gather' = the traffic-proportional kernel: sample whole
+    # gather_block_rows-row blocks XLA-side, DMA ONLY those blocks
+    # (≈frac× the HBM bytes of 'fused'; block-cluster sampling — i.i.d.
+    # per-row equivalent when rows are i.i.d. or pack-time shuffled)
     sampler: str = "bernoulli"
-    fused_pack: int = 16        # rows packed per sublane row ('fused')
+    fused_pack: int = 16        # rows packed per sublane row ('fused*')
     fused_block_rows: int = 8192
+    gather_block_rows: int = 1024   # rows per sampled block ('fused_gather')
+    shuffle_seed: int | None = None  # pack-time row shuffle ('fused_gather')
     # shard the FEATURE dim over the mesh model axis (tensor parallelism):
     # the forward matvec psums partial X_l·w_l over 'model', the gradient
     # contraction psums over 'data' only, and w lives sharded P('model')
@@ -74,14 +79,25 @@ class TrainResult:
         return float(self.accs[-1])
 
 
-def _build_scan(config: SSGDConfig, sample_and_grad):
-    """Shared step/scan builder: ``sample_and_grad(X, y, valid, w, t)`` →
+def _build_scan(config: SSGDConfig, sample_and_grad, prep_xs=None):
+    """Shared step/scan builder: ``sample_and_grad(X, y, valid, w, x)`` →
     global (Σ grad, count); update rule and eval are identical for every
-    sampler (``ssgd.py:105`` semantics)."""
+    sampler (``ssgd.py:105`` semantics).
+
+    ``prep_xs(ts)`` (optional) maps the absolute step ids to the per-step
+    scan inputs — used by 'fused_gather' to draw EVERY step's sampled
+    block ids in one batched PRNG call before the scan (per-step
+    ``jax.random`` traffic inside a scan costs more than the minibatch
+    gradient itself at small batch sizes)."""
 
     def train(X, y, valid, X_test, y_test, w0, t0=0):
-        def step(w, t):
-            g, cnt = sample_and_grad(X, y, valid, w, t)
+        # absolute step ids (t0 offset): segmented checkpoint/resume runs
+        # sample identical minibatches to a straight-through run
+        ts = jnp.arange(config.n_iterations) + t0
+        xs = prep_xs(ts) if prep_xs is not None else ts
+
+        def step(w, x):
+            g, cnt = sample_and_grad(X, y, valid, w, x)
             n_batch = jnp.maximum(cnt, 1.0)  # guard empty sample
             reg = logistic.reg_gradient(
                 w, config.reg_type, config.elastic_alpha
@@ -94,20 +110,16 @@ def _build_scan(config: SSGDConfig, sample_and_grad):
             )
             return w, acc
 
-        # absolute step ids (t0 offset): segmented checkpoint/resume runs
-        # sample identical minibatches to a straight-through run
-        return jax.lax.scan(
-            step, w0, jnp.arange(config.n_iterations) + t0
-        )
+        return jax.lax.scan(step, w0, xs)
 
     return jax.jit(train)
 
 
 def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Build the jitted scan over ``n_iterations`` SSGD steps."""
-    if config.sampler == "fused":
+    if config.sampler in ("fused", "fused_gather"):
         raise ValueError(
-            "sampler='fused' packs labels into X — build via "
+            f"sampler={config.sampler!r} packs labels into X — build via "
             "make_train_fn_fused(mesh, config, meta) with meta from "
             "pallas_kernels.pack_augmented, or use ssgd.train()"
         )
@@ -195,35 +207,104 @@ def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
 
 
 def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
-    """Scan builder for the 'fused' sampler: the packed one-pass Pallas
-    kernel (``pallas_kernels.fused_grad_sum_packed``) inside ``shard_map``
-    over the data axis; (Σg, count) psum'd across shards. The carried
-    weight vector is the augmented (d_total,) layout; the y/v/pad columns
-    are re-zeroed every step (their gradient entries are kernel garbage).
+    """Scan builder for the packed-layout samplers.
+
+    'fused': the streaming one-pass Pallas kernel
+    (``pallas_kernels.fused_grad_sum_packed``) — reads ALL of X each step,
+    samples with the on-core PRNG (TPU-only).  'fused_gather': the
+    traffic-proportional kernel (``fused_grad_sum_gathered``) — samples
+    ``frac·n_blocks`` block ids XLA-side each step and DMAs only those
+    (runs under interpret on CPU too).  Either way the kernel sits inside
+    ``shard_map`` over the data axis with (Σg, count) psum'd across
+    shards; the carried weight vector is the augmented (d_total,) layout
+    and the y/v/pad columns are re-zeroed every step (their gradient
+    entries are kernel garbage).
     """
     from jax import lax
 
     from tpu_distalg.ops import pallas_kernels
     from tpu_distalg.parallel import DATA_AXIS
 
-    if next(iter(mesh.devices.flat)).platform != "tpu":
-        raise ValueError(
-            "sampler='fused' needs a TPU (the on-core PRNG has no "
-            "interpret-mode lowering); use 'bernoulli' elsewhere"
-        )
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
     d_t = meta["d_total"]
     col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(jnp.float32)
-    kern = functools.partial(
-        pallas_kernels.fused_grad_sum_packed,
-        pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
-        v_col=meta["v_col"], fraction=config.mini_batch_fraction,
-        block_rows=config.fused_block_rows,
-    )
+    n_shards = mesh.shape[DATA_AXIS]
+    prep_xs = None
 
-    def _local_grad(X2, w, t):
-        shard = lax.axis_index(DATA_AXIS)
-        g, cnt = kern(X2, w, t + config.seed, shard)
-        return tree_allreduce_sum((g * col_keep, cnt))
+    if config.sampler == "fused_gather":
+        bp = config.gather_block_rows // meta["pack"]
+        n2_local = (meta["n_padded"] // meta["pack"]) // n_shards
+        n_blocks = n2_local // bp
+        if n_blocks * bp != n2_local:
+            raise ValueError(
+                f"gather_block_rows={config.gather_block_rows} must "
+                f"divide the per-shard row count "
+                f"{n2_local * meta['pack']}; re-pack with block_rows a "
+                f"multiple of gather_block_rows × n_shards"
+            )
+        n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+        eff = n_sampled / n_blocks
+        if abs(eff - config.mini_batch_fraction) > \
+                0.25 * config.mini_batch_fraction:
+            import warnings
+
+            warnings.warn(
+                f"fused_gather: {n_blocks} blocks/shard quantizes the "
+                f"minibatch fraction to {eff:.3f} (configured "
+                f"{config.mini_batch_fraction}); lower gather_block_rows "
+                f"or fused_pack for a finer grid", stacklevel=2,
+            )
+        key = prng.root_key(config.seed)
+        kern = functools.partial(
+            pallas_kernels.fused_grad_sum_gathered,
+            pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+            v_col=meta["v_col"],
+            gather_block_rows=config.gather_block_rows,
+            interpret=not on_tpu,
+        )
+
+        def prep_xs(ts):
+            # ALL (step, shard) block draws in one batched threefry +
+            # argsort — a without-replacement sample of n_sampled block
+            # ids per (t, shard), deterministic in the absolute step id
+            def draw(t):
+                ks = jax.vmap(
+                    lambda s: jax.random.fold_in(
+                        jax.random.fold_in(key, t), s
+                    )
+                )(jnp.arange(n_shards))
+                bits = jax.vmap(
+                    lambda k: jax.random.bits(k, (n_blocks,))
+                )(ks)
+                return jnp.argsort(bits, axis=-1)[:, :n_sampled]
+
+            return jax.vmap(draw)(ts).astype(jnp.int32)  # (T, S, ns)
+
+        def _local_grad(X2, w, idx_shards):
+            shard = lax.axis_index(DATA_AXIS)
+            idx = lax.dynamic_index_in_dim(
+                idx_shards, shard, keepdims=False
+            )
+            g, cnt = kern(X2, w, idx)
+            return tree_allreduce_sum((g * col_keep, cnt))
+    else:
+        if not on_tpu:
+            raise ValueError(
+                "sampler='fused' needs a TPU (the on-core PRNG has no "
+                "interpret-mode lowering); use 'fused_gather' or "
+                "'bernoulli' elsewhere"
+            )
+        kern = functools.partial(
+            pallas_kernels.fused_grad_sum_packed,
+            pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+            v_col=meta["v_col"], fraction=config.mini_batch_fraction,
+            block_rows=config.fused_block_rows,
+        )
+
+        def _local_grad(X2, w, t):
+            shard = lax.axis_index(DATA_AXIS)
+            g, cnt = kern(X2, w, t + config.seed, shard)
+            return tree_allreduce_sum((g * col_keep, cnt))
 
     grad_fn = data_parallel(
         _local_grad,
@@ -232,11 +313,11 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
         out_specs=(P(), P()),
     )
 
-    def sample_and_grad(X2, y, valid, w, t):
+    def sample_and_grad(X2, y, valid, w, x):
         del y, valid  # labels/validity ride inside the packed X2
-        return grad_fn(X2, w, t)
+        return grad_fn(X2, w, x)
 
-    return _build_scan(config, sample_and_grad)
+    return _build_scan(config, sample_and_grad, prep_xs=prep_xs)
 
 
 def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
@@ -298,7 +379,7 @@ def train(
     from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
     from jax.sharding import NamedSharding
 
-    if config.sampler == "fused":
+    if config.sampler in ("fused", "fused_gather"):
         return _train_fused(
             X_train, y_train, X_test, y_test, mesh, config,
             checkpoint_dir=checkpoint_dir,
@@ -399,11 +480,15 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     n_shards = mesh.shape[DATA_AXIS]
     d_orig = X_train.shape[1]
     n = X_train.shape[0]
+    block = (config.gather_block_rows
+             if config.sampler == "fused_gather"
+             else config.fused_block_rows)
     X2, meta = pallas_kernels.pack_augmented(
         np.asarray(X_train), np.asarray(y_train), np.ones(n, np.float32),
         dtype=jnp.dtype(config.x_dtype),
         pack=config.fused_pack,
-        block_rows=config.fused_block_rows * n_shards,
+        block_rows=block * n_shards,
+        shuffle_seed=config.shuffle_seed,
     )
     X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
     w0 = jnp.zeros((meta["d_total"],), jnp.float32).at[:d_orig].set(

@@ -27,13 +27,22 @@ pallas_guide.md), discovered the hard way across three kernel generations:
      FLOP overhead buys layout sanity: the MXU is idle in a bandwidth-
      bound step.
 
-Measured on one v5e chip, 1M rows × 128 packed columns, fraction 0.1,
-back-to-back on an idle chip (steps/s): XLA two-pass f32 555 · XLA
-two-pass bf16 772 · v1 92 · v2 858 · v3 1458 (≈1.9× the best XLA path —
-the one-pass traffic saving, realised). A manual double-buffered DMA
-variant of v3 measured no better, so v3 keeps the simpler automatic
-pipeline. Numbers on a shared/tunneled chip vary ±20%; ``bench.py``
-reports the current measurement.
+  v4 (:func:`fused_grad_sum_gathered`, production): v3 still streams
+     100% of X to sample ``fraction`` of it. v4 moves the sampling into
+     the *grid*: the caller draws ``frac·n_blocks`` block ids XLA-side
+     and a scalar-prefetch index map DMAs exactly those blocks — HBM
+     traffic ≈ fraction × |X| per step. (Row-granular gathers are NOT
+     the answer: the XLA 'fixed' row-gather sampler measures ~2× slower
+     than streaming everything; random access serializes on TPU.)
+
+Measured on one v5e chip, 1M rows × 128 packed columns, fraction 0.1
+(steps/s, timed over 1500-step scan segments with host-fetch so tunnel
+dispatch overhead is amortized — see bench.py): XLA two-pass f32 503 ·
+XLA two-pass bf16 668 · XLA 'fixed' row-gather 317-349 · v1 92 · v3
+1398 · **v4 ≈ 11000-13100** (marginal per-step cost 41 µs vs v3's
+360 µs — the traffic argument, realised). Numbers on a shared/tunneled
+chip vary ±20%; ``bench.py`` reports the current measurement, plus the
+bytes-per-step and HBM-peak-fraction the rate implies.
 """
 
 from __future__ import annotations
@@ -135,21 +144,29 @@ def fused_grad_sum(X, y, mask, w, *, block_rows: int = 2048,
 
 
 def pack_augmented(X, y, valid, *, dtype=jnp.bfloat16, pack: int = 16,
-                   block_rows: int = 8192):
-    """Pack (X, y, valid) for :func:`fused_grad_sum_packed` — done ONCE,
-    outside the training scan.
+                   block_rows: int = 8192, shuffle_seed: int | None = None):
+    """Pack (X, y, valid) for :func:`fused_grad_sum_packed` /
+    :func:`fused_grad_sum_gathered` — done ONCE, outside the training scan.
 
     Layout: ``[features… | y | valid | zero-pad]`` per row, row i of the
     augmented matrix at packed position ``[i // pack, (i % pack)·D …]``.
     The total column count D is padded so that ``pack·D`` is a lane-tile
     multiple and rows to a ``block_rows`` multiple (zero rows carry
-    valid=0 and are inert).  Returns ``(X2, meta)`` where ``X2`` has
-    shape (n_padded/pack, pack·D) and ``meta`` is the static dict of
-    (pack, d_total, y_col, v_col, n_padded).
+    valid=0 and are inert).  ``shuffle_seed`` permutes rows once at pack
+    time so the gathered sampler's block-cluster draws are exchangeable
+    with row-level draws even when the input rows are ordered (for the
+    v3 streaming kernel shuffling is a no-op statistically).  Returns
+    ``(X2, meta)`` where ``X2`` has shape (n_padded/pack, pack·D) and
+    ``meta`` is the static dict of (pack, d_total, y_col, v_col,
+    n_padded).
     """
     import numpy as np
 
     X = np.asarray(X, np.float32)
+    if shuffle_seed is not None:
+        perm = np.random.default_rng(shuffle_seed).permutation(X.shape[0])
+        X, y = X[perm], np.asarray(y)[perm]
+        valid = np.asarray(valid)[perm]
     n, d = X.shape
     y_col, v_col = d, d + 1
     lane_q = 128 // np.gcd(pack, 128)     # smallest D granularity
@@ -198,6 +215,122 @@ def _grad_kernel_packed(s_ref, x_ref, c_ref, gacc_ref, cnt_ref, acc_ref,
     def _done():
         gacc_ref[:] = acc_ref[:]
         cnt_ref[0, 0] = cacc_ref[0, 0]
+
+
+def _grad_kernel_gathered(idx_ref, x_ref, c_ref, gacc_ref, cnt_ref,
+                          acc_ref, cacc_ref, *, pack: int):
+    """v4 body: like :func:`_grad_kernel_packed` but with NO on-core
+    sampling — the sampling already happened in the *grid*: the block
+    index map reads ``idx_ref`` (scalar-prefetched sampled block ids), so
+    only the minibatch's blocks are ever DMA'd from HBM. Every resident
+    row counts (modulo the packed validity column)."""
+    del idx_ref  # consumed by the BlockSpec index_map, not the body
+    P = pack
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        cacc_ref[0, 0] = 0.0
+
+    x2 = x_ref[:]                                   # (bp, P·D), ONE read
+    zyv = jnp.dot(x2, c_ref[:], preferred_element_type=jnp.float32)
+    z, y, v = zyv[:, :P], zyv[:, P:2 * P], zyv[:, 2 * P:3 * P]
+    resid = ((jax.nn.sigmoid(z) - y) * v).astype(x2.dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        resid, x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                               # (P, P·D) MXU
+    cacc_ref[0, 0] += jnp.sum(v)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _done():
+        gacc_ref[:] = acc_ref[:]
+        cnt_ref[0, 0] = cacc_ref[0, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pack", "d_total", "y_col", "v_col",
+                     "gather_block_rows", "interpret"),
+)
+def fused_grad_sum_gathered(X2, w_aug, block_idx, *, pack: int,
+                            d_total: int, y_col: int, v_col: int,
+                            gather_block_rows: int = 1024,
+                            interpret: bool = False):
+    """Traffic-proportional (Σ gradient, count): ONE pass over only the
+    SAMPLED blocks of X (v4).
+
+    The v3 kernel (:func:`fused_grad_sum_packed`) still streams 100% of X
+    to sample a ``fraction`` of it — HBM traffic 1/fraction× what the
+    algorithm needs. Here the minibatch is drawn at *block* granularity:
+    the caller samples ``block_idx`` (ids of ``gather_block_rows``-row
+    blocks, XLA-side PRNG) and the scalar-prefetch index map DMAs exactly
+    those blocks, so traffic ≈ fraction × |X| per step. Row-level random
+    gathers are NOT the answer on TPU — they serialize (the 'fixed'
+    sampler measures ~2× *slower* than streaming everything); whole-block
+    DMA keeps transfers wide.
+
+    Semantics: block-cluster sampling — sampling whole blocks of
+    consecutive rows instead of i.i.d. rows (Spark's per-partition
+    ``sample`` is the same kind of partition-clustered approximation,
+    reference ``ssgd.py:97``). For i.i.d. or pre-shuffled rows
+    (``pack_augmented(shuffle_seed=...)``) the sampled-gradient
+    distribution is identical to row-level sampling at equal batch size.
+
+    No on-core PRNG → runs under ``interpret=True`` on CPU, unlike v3.
+    Returns the (d_total,) gradient (garbage y/v/pad entries — zero via
+    the meta col mask) and the kept-row count.
+    """
+    P, D = pack, d_total
+    n2, pd = X2.shape
+    bp = gather_block_rows // P
+    if (pd != P * D or (P * D) % 128 or gather_block_rows % P
+            or bp == 0 or n2 % bp):
+        raise ValueError(
+            f"fused_grad_sum_gathered: X2 {X2.shape} incompatible with "
+            f"pack={P}, d_total={D}, gather_block_rows={gather_block_rows}"
+        )
+    if bp % 8:
+        # TPU tiling: the block's sublane dim must be a multiple of 8
+        raise ValueError(
+            f"gather_block_rows={gather_block_rows} gives {bp} packed "
+            f"rows per block; need a multiple of 8·pack={8 * P} rows"
+        )
+    C = build_selector(w_aug, pack=P, d_total=D, y_col=y_col,
+                       v_col=v_col, dtype=X2.dtype)
+    kernel = functools.partial(_grad_kernel_gathered, pack=P)
+    gacc, cnt = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(block_idx.shape[0],),
+            in_specs=[
+                pl.BlockSpec((bp, P * D), lambda i, s: (s[i], 0)),
+                pl.BlockSpec((P * D, 3 * P), lambda i, s: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((P, P * D), lambda i, s: (0, 0)),
+                pl.BlockSpec((1, 1), lambda i, s: (0, 0),
+                             memory_space=pltpu.SMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((P, P * D), jnp.float32),
+                pltpu.SMEM((1, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((P, P * D), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(block_idx.astype(jnp.int32), X2, C)
+    g = jnp.einsum("ccj->j", gacc.reshape(P, P, D))
+    return g, cnt[0, 0]
 
 
 def build_selector(w_aug, *, pack: int, d_total: int, y_col: int,
