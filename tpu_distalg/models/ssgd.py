@@ -206,6 +206,23 @@ def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, sample_and_grad)
 
 
+def fused_gather_geometry(config: SSGDConfig, meta: dict, n_shards: int):
+    """Per-shard block-sampling geometry of the 'fused_gather' sampler:
+    (blocks per shard, blocks sampled per shard per step). Single source
+    of truth — bench.py derives its bytes-per-step claim from this."""
+    bp = config.gather_block_rows // meta["pack"]
+    n2_local = (meta["n_padded"] // meta["pack"]) // n_shards
+    n_blocks = n2_local // bp
+    if n_blocks * bp != n2_local:
+        raise ValueError(
+            f"gather_block_rows={config.gather_block_rows} must divide "
+            f"the per-shard row count {n2_local * meta['pack']}; re-pack "
+            f"with block_rows a multiple of gather_block_rows × n_shards"
+        )
+    n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+    return n_blocks, n_sampled
+
+
 def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     """Scan builder for the packed-layout samplers.
 
@@ -232,17 +249,8 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     prep_xs = None
 
     if config.sampler == "fused_gather":
-        bp = config.gather_block_rows // meta["pack"]
-        n2_local = (meta["n_padded"] // meta["pack"]) // n_shards
-        n_blocks = n2_local // bp
-        if n_blocks * bp != n2_local:
-            raise ValueError(
-                f"gather_block_rows={config.gather_block_rows} must "
-                f"divide the per-shard row count "
-                f"{n2_local * meta['pack']}; re-pack with block_rows a "
-                f"multiple of gather_block_rows × n_shards"
-            )
-        n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+        n_blocks, n_sampled = fused_gather_geometry(
+            config, meta, n_shards)
         eff = n_sampled / n_blocks
         if abs(eff - config.mini_batch_fraction) > \
                 0.25 * config.mini_batch_fraction:
@@ -493,6 +501,87 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
     w0 = jnp.zeros((meta["d_total"],), jnp.float32).at[:d_orig].set(
         logistic.init_weights(prng.root_key(config.init_seed), d_orig)
+    )
+    fn = make_train_fn_fused(mesh, config, meta)
+    return fn, X2, w0, meta
+
+
+def prepare_fused_synthetic(
+    n_rows: int, n_features: int, mesh: Mesh, config: SSGDConfig,
+    *, data_seed: int = 0, separation: float = 2.0,
+    chunk_rows: int = 1 << 20,
+):
+    """Scale-out variant of :func:`prepare_fused`: the packed design
+    matrix is synthesized ON DEVICE, shard by shard — host memory use is
+    O(1) in ``n_rows``, which is what the 1B-row north star
+    (BASELINE.json) requires. The reference materializes its whole
+    matrix on the driver (``/root/reference/optimization/ssgd.py:86``);
+    ``parallelize``/``pack_augmented`` mirror that and top out at host
+    RAM. Rows here are generated from a counter-based per-row PRNG
+    (``datasets.synthetic_two_class_rows``), so content is
+    topology-independent and no shuffle is needed (rows are i.i.d. by
+    construction — block-cluster sampling is exactly row sampling).
+
+    Generation runs in ``chunk_rows`` chunks inside a ``lax.map`` so the
+    f32 intermediates stay chunk-sized; only the final dtype-cast packed
+    array occupies HBM. Returns ``(fn, X2, w0, meta)`` like
+    :func:`prepare_fused`.
+    """
+    import numpy as np
+
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import DATA_AXIS
+    from tpu_distalg.utils import datasets as dsets
+
+    n_shards = mesh.shape[DATA_AXIS]
+    pk = config.fused_pack
+    d = n_features + 1  # + bias column (ssgd.py:83-84)
+    d_t, y_col, v_col = pallas_kernels.packed_dims(d, pk)
+    block = (config.gather_block_rows
+             if config.sampler == "fused_gather"
+             else config.fused_block_rows)
+    mult = max(block, pk) * n_shards
+    n_t = n_rows + ((-n_rows) % mult)
+    n_local = n_t // n_shards
+    chunk = min(chunk_rows, n_local)
+    while chunk and (n_local % chunk or chunk % pk):
+        chunk //= 2
+    if chunk == 0:
+        raise ValueError(
+            f"cannot chunk n_local={n_local} rows by pack={pk}"
+        )
+    n_chunks = n_local // chunk
+    make_rows = dsets.synthetic_two_class_rows(
+        n_features, data_seed, separation)
+    dtype = jnp.dtype(config.x_dtype)
+
+    def body():
+        s = lax.axis_index(DATA_AXIS)
+
+        def gen_chunk(c):
+            ids = s * n_local + c * chunk + jnp.arange(chunk)
+            X, y = make_rows(ids)
+            valid = (ids < n_rows).astype(jnp.float32)
+            cols = [X, jnp.ones((chunk, 1)), y[:, None], valid[:, None]]
+            if d_t > d + 2:
+                cols.append(jnp.zeros((chunk, d_t - d - 2)))
+            rows = jnp.concatenate(cols, axis=1).astype(dtype)
+            return rows.reshape(chunk // pk, pk * d_t)
+
+        chunks = lax.map(gen_chunk, jnp.arange(n_chunks))
+        return chunks.reshape(n_local // pk, pk * d_t)
+
+    spec = P(DATA_AXIS, None)
+    f = shard_map(body, mesh=mesh, in_specs=(), out_specs=spec)
+    X2 = jax.jit(f, out_shardings=NamedSharding(mesh, spec))()
+    meta = dict(pack=pk, d_total=d_t, y_col=y_col, v_col=v_col,
+                n_padded=n_t)
+    w0 = jnp.zeros((d_t,), jnp.float32).at[:d].set(
+        logistic.init_weights(prng.root_key(config.init_seed), d)
     )
     fn = make_train_fn_fused(mesh, config, meta)
     return fn, X2, w0, meta

@@ -143,6 +143,20 @@ def fused_grad_sum(X, y, mask, w, *, block_rows: int = 2048,
     return g[:d, 0], cnt[0, 0]
 
 
+def packed_dims(d: int, pack: int):
+    """Static packed-layout geometry shared by :func:`pack_augmented`
+    (host packing) and on-device synthesis: total padded column count
+    ``d_t`` (features + y + valid + zero-pad, rounded so ``pack·d_t`` is
+    a lane-tile multiple) and the y/valid column positions."""
+    import numpy as np
+
+    y_col, v_col = d, d + 1
+    lane_q = 128 // int(np.gcd(pack, 128))   # smallest D granularity
+    d_t = d + 2 + ((-(d + 2)) % lane_q)
+    assert (pack * d_t) % 128 == 0           # lane_q rounding guarantees it
+    return int(d_t), y_col, v_col
+
+
 def pack_augmented(X, y, valid, *, dtype=jnp.bfloat16, pack: int = 16,
                    block_rows: int = 8192, shuffle_seed: int | None = None):
     """Pack (X, y, valid) for :func:`fused_grad_sum_packed` /
@@ -168,10 +182,7 @@ def pack_augmented(X, y, valid, *, dtype=jnp.bfloat16, pack: int = 16,
         X, y = X[perm], np.asarray(y)[perm]
         valid = np.asarray(valid)[perm]
     n, d = X.shape
-    y_col, v_col = d, d + 1
-    lane_q = 128 // np.gcd(pack, 128)     # smallest D granularity
-    d_t = d + 2 + ((-(d + 2)) % lane_q)
-    assert (pack * d_t) % 128 == 0        # lane_q rounding guarantees it
+    d_t, y_col, v_col = packed_dims(d, pack)
     n_t = n + ((-n) % max(block_rows, pack))
     out = np.zeros((n_t, d_t), np.float32)
     out[:n, :d] = X

@@ -16,6 +16,7 @@ from tpu_distalg.parallel.mesh import (
 )
 from tpu_distalg.parallel.sharding import (
     ShardedMatrix,
+    build_sharded,
     data_sharding,
     pad_rows,
     parallelize,
@@ -23,6 +24,8 @@ from tpu_distalg.parallel.sharding import (
     replicated_sharding,
 )
 from tpu_distalg.parallel.collectives import (
+    all_gather,
+    all_to_all,
     tree_allreduce_mean,
     tree_allreduce_sum,
     ring_shift,
@@ -34,6 +37,9 @@ __all__ = [
     "MODEL_AXIS",
     "MeshContext",
     "ShardedMatrix",
+    "all_gather",
+    "all_to_all",
+    "build_sharded",
     "data_parallel",
     "data_sharding",
     "get_mesh",

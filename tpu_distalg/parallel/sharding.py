@@ -54,9 +54,10 @@ def pad_rows(x: np.ndarray | jax.Array, multiple: int):
 class ShardedMatrix:
     """A row-sharded dataset: the framework's stand-in for a cached RDD.
 
-    ``data`` is ``(n_padded, ...)`` sharded over the mesh data axis;
-    ``mask`` is 1.0 for real rows, 0.0 for padding; ``n_valid`` is the
-    original row count.
+    ``data`` is ``(n_padded, ...)`` sharded over the mesh data axis — a
+    single array from :func:`parallelize`, possibly a pytree of aligned
+    arrays from :func:`build_sharded`; ``mask`` is 1.0 for real rows,
+    0.0 for padding; ``n_valid`` is the original row count.
     """
 
     data: jax.Array
@@ -65,7 +66,7 @@ class ShardedMatrix:
 
     @property
     def n_padded(self) -> int:
-        return self.data.shape[0]
+        return self.mask.shape[0]
 
 
 def parallelize(
@@ -94,3 +95,62 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(
         lambda x: jax.device_put(jnp.asarray(x), sharding), tree
     )
+
+
+def build_sharded(
+    mesh: Mesh,
+    n_rows: int,
+    make_rows,
+    *,
+    row_multiple: int = 1,
+) -> ShardedMatrix:
+    """Construct a row-sharded dataset ON DEVICE — the scale-out sibling
+    of :func:`parallelize`.
+
+    ``parallelize`` materializes the full array on the host first
+    (``np.pad`` + ``device_put``) — at the 1B-row north-star scale
+    (BASELINE.json) that is ~100s of GB of host RAM for data that is
+    synthesized anyway (the reference builds its matrix host-side too,
+    ``/root/reference/optimization/ssgd.py:86``, which is exactly the
+    pattern that cannot scale). Here each shard's rows are generated
+    inside a ``shard_map`` body on the device that owns them: host
+    memory use is O(1) in ``n_rows`` and every host in a multi-host mesh
+    only ever touches its own addressable shards.
+
+    ``make_rows(row_ids)`` must be jittable: given the shard's global row
+    ids ``(n_local,)`` it returns a pytree of ``(n_local, ...)`` row
+    blocks (e.g. ``(X_rows, y_rows)``). Content should depend only on
+    ``row_ids`` (e.g. fold them into a PRNG key), making the dataset
+    topology-independent. Rows are padded to a multiple of
+    ``row_multiple × n_shards``; padded rows carry mask 0.
+    """
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+
+    n_shards = mesh.shape[DATA_AXIS]
+    mult = n_shards * row_multiple
+    n_padded = -(-n_rows // mult) * mult
+    n_local = n_padded // n_shards
+
+    def body():
+        s = lax.axis_index(DATA_AXIS)
+        ids = s * n_local + jnp.arange(n_local)
+        rows = make_rows(ids)
+        mask = (ids < n_rows).astype(jnp.float32)
+        return rows, mask
+
+    # trace abstractly to learn each row block's rank for out_specs
+    shapes = jax.eval_shape(
+        make_rows, jax.ShapeDtypeStruct((n_local,), jnp.int32)
+    )
+    specs = jax.tree.map(
+        lambda sh: P(DATA_AXIS, *([None] * (sh.ndim - 1))), shapes
+    )
+    f = shard_map(
+        body, mesh=mesh, in_specs=(), out_specs=(specs, P(DATA_AXIS)),
+    )
+    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs)
+    data, mask = jax.jit(f, out_shardings=(
+        shardings, data_sharding(mesh, 1)
+    ))()
+    return ShardedMatrix(data=data, mask=mask, n_valid=n_rows)

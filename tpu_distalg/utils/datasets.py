@@ -53,6 +53,39 @@ def synthetic_two_class(
     return X, y
 
 
+def synthetic_two_class_rows(n_features: int, seed: int = 0,
+                             separation: float = 2.0):
+    """Jittable per-row generator for ``parallel.build_sharded`` — the
+    host-memory-free sibling of :func:`synthetic_two_class` (same
+    distribution, counter-based per-row PRNG so content depends only on
+    the global row id, not the shard topology). Returns
+    ``make_rows(row_ids) -> (X_rows, y_rows)``; the bias column is NOT
+    appended (compose with a column of ones like ``add_bias_column``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_distalg.utils import prng
+
+    key = prng.root_key(seed)
+    k_w, k_rows = jax.random.fold_in(key, 0), jax.random.fold_in(key, 1)
+
+    def make_rows(ids):
+        w_true = jax.random.normal(k_w, (n_features,))
+        row_keys = jax.vmap(lambda i: jax.random.fold_in(k_rows, i))(ids)
+        X = jax.vmap(
+            lambda k: jax.random.normal(k, (n_features,))
+        )(row_keys)
+        logits = X @ w_true * (separation / jnp.sqrt(n_features))
+        noise = jax.vmap(
+            lambda k: jax.random.logistic(jax.random.fold_in(k, 7))
+        )(row_keys)
+        y = (logits + noise > 0).astype(jnp.float32)
+        return X, y
+
+    return make_rows
+
+
 def gaussian_mixture(
     n_rows: int, k: int = 4, dim: int = 2, seed: int = 0, spread: float = 8.0
 ) -> np.ndarray:
