@@ -222,3 +222,79 @@ def test_als_model_axis_nondivisible_falls_back(mesh_2x4):
     res = als.fit(mesh_2x4, cfg)
     assert res.final_rmse < 1e-2
     assert res.V.shape == (500, 8)
+
+
+def test_sparse_closure_toy_graph(mesh8):
+    """Sparse sort-dedup closure matches the reference toy golden
+    (transitive_closure.py:42): 9 paths."""
+    res = transitive_closure.run_sparse(datasets.toy_graph_edges(), mesh8)
+    assert res.n_paths == 9
+    assert res.paths.shape == (9, 2)
+
+
+def test_sparse_closure_matches_dense(mesh8):
+    """Sparse and dense fixpoints agree on random sparse graphs."""
+    rng = np.random.default_rng(0)
+    for trial in range(3):
+        V, E = 40, 50
+        edges = rng.integers(0, V, size=(E, 2))
+        edges = edges[edges[:, 0] != edges[:, 1]]
+        dense = transitive_closure.run(edges, mesh8, n_vertices=V)
+        sparse = transitive_closure.run_sparse(
+            edges, mesh8,
+            transitive_closure.SparseClosureConfig(capacity=V * V),
+            n_vertices=V)
+        assert sparse.n_paths == dense.n_paths
+        # pair sets identical
+        dm = np.asarray(dense.paths)[:V, :V]
+        got = set(map(tuple, sparse.paths.tolist()))
+        want = set(zip(*np.nonzero(dm)))
+        assert got == want
+
+
+def test_sparse_closure_100k_vertices(mesh8):
+    """100k vertices on the 8-device CPU mesh WITHOUT O(V²) memory —
+    the scale the dense path cannot touch (100k² bools = 10 GB). Graph:
+    12.5k disjoint 8-chains; closure = 12500 · C(8,2) = 350k pairs."""
+    V, L = 100_000, 8
+    edges = datasets.chain_forest_edges(V, L)
+    res = transitive_closure.run_sparse(
+        edges, mesh8,
+        transitive_closure.SparseClosureConfig(capacity=1 << 20),
+        n_vertices=V)
+    assert res.n_paths == (V // L) * (L * (L - 1) // 2)
+    # longest path has length 7 → count stabilises by round ~7
+    assert res.n_rounds <= 10
+
+
+def test_sparse_closure_capacity_overflow(mesh8):
+    """Too-small capacity fails loudly, not with a truncated answer."""
+    edges = np.stack([np.arange(63), np.arange(1, 64)], axis=1)  # 64-chain
+    with pytest.raises(ValueError, match="capacity"):
+        transitive_closure.run_sparse(
+            edges, mesh8,
+            transitive_closure.SparseClosureConfig(capacity=128))
+
+
+def test_sparse_closure_skewed_degrees(mesh8):
+    """A hub with 5k out-edges (max_deg >> avg_deg): the CSR segmented
+    expand pays for the TRUE join size, not V x max_deg padding."""
+    V = 5_001
+    hub_edges = np.stack(
+        [np.zeros(V - 1, np.int64), np.arange(1, V)], axis=1)
+    res = transitive_closure.run_sparse(
+        hub_edges, mesh8,
+        transitive_closure.SparseClosureConfig(capacity=8192),
+        n_vertices=V)
+    assert res.n_paths == V - 1  # star closure = the edges themselves
+    assert res.n_rounds <= 2
+
+
+def test_sparse_closure_exact_capacity_fit(mesh8):
+    """Closure exactly filling the buffer is a complete answer, not an
+    overflow (the flag tracks true truncation only)."""
+    edges = datasets.chain_forest_edges(16, 16)  # closure = C(16,2) = 120
+    res = transitive_closure.run_sparse(
+        edges, mesh8,
+        transitive_closure.SparseClosureConfig(capacity=120))
+    assert res.n_paths == 120

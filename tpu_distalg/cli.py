@@ -99,6 +99,11 @@ def main(argv=None):
     p = sub.add_parser("closure", help="transitive closure")
     p.add_argument("--n-slices", type=int, default=0)
     p.add_argument("--n-vertices", type=int, default=0)
+    p.add_argument("--sparse", action="store_true",
+                   help="sort-dedup path-set closure (O(closure) memory "
+                        "— required beyond ~30k vertices)")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="sparse path-buffer capacity; 0 = 8x edges")
 
     p = sub.add_parser("als", help="ALS matrix decomposition")
     p.add_argument("--n-slices", type=int, default=0)
@@ -203,9 +208,19 @@ def main(argv=None):
         from tpu_distalg.models import transitive_closure as m
         from tpu_distalg.utils import datasets
 
-        edges = (datasets.toy_graph_edges() if args.n_vertices == 0
-                 else datasets.erdos_renyi_edges(args.n_vertices, 2.0))
-        res = m.run(edges, _mesh(args))
+        if args.n_vertices == 0:
+            edges = datasets.toy_graph_edges()
+        elif args.sparse:
+            # bounded-closure graph: an ER graph's closure is Θ(V²) pairs
+            # (inherently quadratic output) — chains keep it linear in V
+            edges = datasets.chain_forest_edges(args.n_vertices)
+        else:
+            edges = datasets.erdos_renyi_edges(args.n_vertices, 2.0)
+        if args.sparse:
+            res = m.run_sparse(edges, _mesh(args), m.SparseClosureConfig(
+                capacity=args.capacity or None))
+        else:
+            res = m.run(edges, _mesh(args))
         print(f"The original graph has {res.n_paths} paths "
               f"({res.n_rounds} rounds)")
 

@@ -38,6 +38,30 @@ class ClosureResult:
     n_rounds: int
 
 
+@dataclasses.dataclass(frozen=True)
+class SparseClosureConfig:
+    """Config for :func:`run_sparse` — the O(closure-size) formulation.
+
+    ``capacity`` bounds the number of distinct paths the buffer can hold
+    (static shape; auto = 8×edges). ``join_capacity`` bounds the number
+    of (path ⋈ edge) candidates one round may produce (auto =
+    max(2×capacity, 8×edges)); unlike a per-vertex-degree pad this is a
+    bound on the TRUE join size, so skewed degree distributions cost
+    nothing extra. ``max_iterations`` caps the fixpoint (auto = longest
+    possible path, V)."""
+
+    capacity: int | None = None
+    join_capacity: int | None = None
+    max_iterations: int | None = None
+
+
+@dataclasses.dataclass
+class SparseClosureResult:
+    paths: np.ndarray  # (n_paths, 2) distinct (x, z) pairs
+    n_paths: int
+    n_rounds: int
+
+
 def run(edges: np.ndarray, mesh: Mesh,
         config: ClosureConfig = ClosureConfig(),
         n_vertices: int | None = None) -> ClosureResult:
@@ -74,4 +98,146 @@ def run(edges: np.ndarray, mesh: Mesh,
     paths, _, cnt, rounds = fixpoint(jnp.asarray(adj))
     return ClosureResult(
         paths=paths, n_paths=int(cnt), n_rounds=int(rounds)
+    )
+
+
+def run_sparse(edges: np.ndarray, mesh: Mesh,
+               config: SparseClosureConfig = SparseClosureConfig(),
+               n_vertices: int | None = None) -> SparseClosureResult:
+    """Transitive closure without the V×V matrix — O(closure size) memory.
+
+    The dense fixpoint (:func:`run`) is the right shape for small/dense
+    graphs (boolean matmul rides the MXU) but its V×V path matrix is dead
+    at ~100k+ vertices (SURVEY.md §2.2 names the alternative: "sort-based
+    dedup for sparse"). Here the path set is what Spark's RDD was — a set
+    of (x, z) pairs — mapped to static shapes:
+
+      * a capacity-capped ``(C,)`` pair buffer, valid entries sorted
+        first, sentinel (V, V) padding sorting last;
+      * one round ≙ the reference's ``join`` + ``union().distinct()``
+        (``transitive_closure.py:33-37``): a CSR segmented-expand joins
+        every path (x, y) with y's out-edges — per-path counts →
+        prefix-sum → scatter-max path markers → ``cummax`` recovers the
+        owning path of each candidate slot, so the round's work is
+        proportional to the TRUE join size (no per-vertex degree
+        padding; skewed graphs cost nothing extra) — then concatenate
+        with the known set (union), two-key ``lax.sort`` +
+        neighbor-diff mask (distinct), and one more sort to compact
+        uniques back into the buffer;
+      * fixpoint when ``count`` stops growing — the reference's
+        count-based convergence (``:38-40``), inside ``lax.while_loop``.
+
+    Like the reference it re-joins the FULL path set each round (naïve,
+    not frontier/semi-naïve — same asymptotics as the original). The
+    sort-dedup is the shuffle equivalent and runs as one global XLA sort.
+
+    Raises if ``capacity`` or ``join_capacity`` overflow (closure or
+    one round's join bigger than its buffer).
+    """
+    el = gops.prepare_edges(edges, n_vertices)
+    V = el.n_vertices
+    E = el.n_edges
+    n_shards = mesh.shape[DATA_AXIS]
+    C = (config.capacity if config.capacity is not None
+         else max(8 * E, 1024))
+    C = -(-C // n_shards) * n_shards
+    J = (config.join_capacity if config.join_capacity is not None
+         else max(2 * C, 8 * E, 1024))
+    cap = (config.max_iterations if config.max_iterations is not None
+           else V + 1)
+
+    from tpu_distalg import native
+
+    if E > C:
+        raise ValueError(f"capacity {C} < edge count {E}")
+    # CSR over src (prepare_edges sorts by src); sentinel vertex V has
+    # degree 0 so expanding an invalid path yields nothing
+    offsets = np.zeros(V + 2, dtype=np.int64)
+    if E:
+        offsets[: V + 1] = native.csr_offsets(el.src.astype(np.int64), V)
+        offsets[V + 1] = offsets[V]
+    deg = np.diff(offsets).astype(np.int32)          # (V+1,)
+    px0 = np.full(C, V, dtype=np.int32)
+    pz0 = np.full(C, V, dtype=np.int32)
+    px0[:E] = el.src
+    pz0[:E] = el.dst
+
+    # the path buffer stays REPLICATED: the sort-dedup is inherently
+    # global, and XLA's partitioned sort on a row-sharded buffer (tested
+    # on the 8-device CPU mesh) is orders of magnitude slower than one
+    # local sort — the shuffle this replaces was Spark's global shuffle
+    # too. Memory is O(closure), not O(V²), so replication is cheap.
+    px0 = jnp.asarray(px0)
+    pz0 = jnp.asarray(pz0)
+    deg_d = jnp.asarray(deg)
+    off_d = jnp.asarray(offsets[: V + 1].astype(np.int32))
+    dst_d = jnp.asarray(el.dst)                      # src-sorted
+
+    @jax.jit
+    def fixpoint(px, pz, deg, off, dst):
+        def count_valid(x):
+            return jnp.sum((x < V).astype(jnp.int32))
+
+        def cond(state):
+            _, _, old_cnt, cnt, it, _ = state
+            return (cnt != old_cnt) & (it < cap)
+
+        def body(state):
+            px, pz, _, cnt, it, overflow = state
+            # join (x,y) ⋈ edges(y,·) via segmented expand: path p owns
+            # candidate slots [start_p, start_p + deg(pz_p))
+            k = deg[pz]                              # (C,)
+            start = jnp.cumsum(k) - k                # exclusive prefix
+            K = start[-1] + k[-1]                    # true join size
+            overflow = overflow | (K > J)
+            # mark slot start_p with p+1 (k>0 paths only), cummax fills
+            # the segment; -1 → owning path id
+            marks = jnp.zeros((J,), jnp.int32).at[
+                jnp.where(k > 0, start, J)
+            ].max(jnp.arange(C, dtype=jnp.int32) + 1, mode="drop")
+            pid = jax.lax.cummax(marks) - 1          # (J,)
+            slot = jnp.arange(J, dtype=jnp.int32)
+            valid = (slot < K) & (pid >= 0)
+            pid = jnp.where(valid, pid, 0)
+            rank = slot - start[pid]
+            eidx = jnp.clip(off[pz[pid]] + rank, 0, max(E - 1, 0))
+            cx = jnp.where(valid, px[pid], V)
+            cz = jnp.where(valid, dst[eidx], V) if E else jnp.full(
+                (J,), V, jnp.int32)
+            ax = jnp.concatenate([px, cx])           # union
+            az = jnp.concatenate([pz, cz])
+            ax, az = jax.lax.sort((ax, az), num_keys=2)
+            dup = jnp.concatenate([
+                jnp.zeros((1,), bool),
+                (ax[1:] == ax[:-1]) & (az[1:] == az[:-1]),
+            ])
+            uniq = (ax < V) & ~dup                   # distinct
+            ax = jnp.where(uniq, ax, V)
+            az = jnp.where(uniq, az, V)
+            ax, az = jax.lax.sort((ax, az), num_keys=2)  # compact
+            new_cnt = count_valid(ax)
+            overflow = overflow | (new_cnt > C)
+            return (ax[:C], az[:C], cnt, jnp.minimum(new_cnt, C),
+                    it + 1, overflow)
+
+        cnt0 = count_valid(px)
+        return jax.lax.while_loop(
+            cond, body,
+            (px, pz, jnp.int32(-1), cnt0, jnp.int32(0), jnp.bool_(False)),
+        )
+
+    px, pz, _, cnt, rounds, overflow = fixpoint(
+        px0, pz0, deg_d, off_d, dst_d)
+    n_paths = int(cnt)
+    if bool(overflow):
+        raise ValueError(
+            f"closure overflowed its buffers (capacity {C}, "
+            f"join_capacity {J}); rerun with a larger "
+            f"SparseClosureConfig.capacity/join_capacity"
+        )
+    pairs = np.stack(
+        [np.asarray(px[:n_paths]), np.asarray(pz[:n_paths])], axis=1
+    )
+    return SparseClosureResult(
+        paths=pairs, n_paths=n_paths, n_rounds=int(rounds)
     )

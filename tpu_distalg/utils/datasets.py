@@ -109,6 +109,19 @@ def erdos_renyi_edges(
     return np.stack([src, dst], axis=1)
 
 
+def chain_forest_edges(n_vertices: int, chain_len: int = 8) -> np.ndarray:
+    """Disjoint directed chains — a bounded-closure benchmark graph (the
+    closure of an ER graph in the supercritical regime is Θ(V²) pairs, an
+    inherently quadratic OUTPUT no sparse representation can avoid; chains
+    give closure = (V/L)·C(L,2), linear in V)."""
+    chain_len = max(2, min(chain_len, n_vertices))
+    if n_vertices < 2:
+        return np.zeros((0, 2), dtype=np.int64)
+    starts = np.arange(0, n_vertices - chain_len + 1, chain_len)
+    src = np.concatenate([s + np.arange(chain_len - 1) for s in starts])
+    return np.stack([src, src + 1], axis=1).astype(np.int64)
+
+
 def toy_graph_edges() -> np.ndarray:
     """The reference's 4-edge toy graph (``pagerank.py:35-38``,
     ``transitive_closure.py:18``), 0-indexed."""
