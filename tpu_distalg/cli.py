@@ -38,6 +38,9 @@ def _add_common(p, n_iterations, eta=None, frac=None):
     p.add_argument("--plot", type=str, default=None,
                    help="save an accuracy plot PNG here")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="segmented checkpoint/resume directory")
+    p.add_argument("--checkpoint-every", type=int, default=500)
 
 
 def _report_optimizer(name, res, args, t):
@@ -101,7 +104,12 @@ def main(argv=None):
     p.add_argument("--n-vertices", type=int, default=0)
     p.add_argument("--sparse", action="store_true",
                    help="sort-dedup path-set closure (O(closure) memory "
-                        "— required beyond ~30k vertices)")
+                        "— required beyond ~30k vertices). NOTE: with "
+                        "--n-vertices the generated graph is a chain "
+                        "forest, not the dense mode's Erdős–Rényi graph "
+                        "(an ER closure is an inherently quadratic "
+                        "output); results are not comparable across "
+                        "modes")
     p.add_argument("--capacity", type=int, default=0,
                    help="sparse path-buffer capacity; 0 = 8x edges")
 
@@ -136,14 +144,18 @@ def main(argv=None):
             from tpu_distalg.models import logistic_regression as m
 
             res = m.train(*data, mesh, m.LRConfig(
-                n_iterations=args.n_iterations, eta=args.eta))
+                n_iterations=args.n_iterations, eta=args.eta),
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every)
         elif args.cmd == "ssgd":
             from tpu_distalg.models import ssgd as m
 
             res = m.train(*data, mesh, m.SSGDConfig(
                 n_iterations=args.n_iterations, eta=args.eta,
                 mini_batch_fraction=args.mini_batch_fraction,
-                lam=args.lam, reg_type=args.reg_type))
+                lam=args.lam, reg_type=args.reg_type),
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every)
         else:
             mod = {
                 "ma": "MAConfig", "bmuf": "BMUFConfig", "easgd": "EASGDConfig"
@@ -156,7 +168,9 @@ def main(argv=None):
                 n_iterations=args.n_iterations, eta=args.eta,
                 mini_batch_fraction=args.mini_batch_fraction,
                 n_local_iterations=args.n_local_iterations,
-                resample_per_local_step=args.resample_per_local_step))
+                resample_per_local_step=args.resample_per_local_step),
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every)
         jax.block_until_ready(res.w)
         _report_optimizer(args.cmd, res, args, time.perf_counter() - t0)
 

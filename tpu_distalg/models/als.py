@@ -108,7 +108,14 @@ def make_fit_fn(mesh: Mesh, config: ALSConfig):
 
 
 def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
-        R: np.ndarray | None = None) -> ALSResult:
+        R: np.ndarray | None = None,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5) -> ALSResult:
+    """Fit U·Vᵀ ≈ R; optionally checkpointed per ``checkpoint_every``
+    sweeps (carry = the (U, V) factor pair; ALS sweeps are
+    deterministic functions of the factors, so segmented and straight
+    runs are bitwise-identical)."""
     if R is None:
         R = synthesize_rank_k(config)
     elif R.shape != (config.m, config.n):
@@ -130,6 +137,29 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
     U_dev = jax.device_put(jnp.asarray(U0), rows)
     V_dev = jax.device_put(jnp.asarray(V0), repl)
 
-    fn = make_fit_fn(mesh, config)
-    U, V, errs = fn(R_dev, U_dev, V_dev)
-    return ALSResult(U=U[: config.m], V=V, rmse_history=errs)
+    if checkpoint_dir is None:
+        fn = make_fit_fn(mesh, config)
+        U, V, errs = fn(R_dev, U_dev, V_dev)
+        return ALSResult(U=U[: config.m], V=V, rmse_history=errs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    def run_seg(fn, state, t0):
+        del t0  # sweeps carry no PRNG; the factors are the whole state
+        U, V = state
+        U = jax.device_put(jnp.asarray(U), rows)
+        V = jax.device_put(jnp.asarray(V), repl)
+        U, V, errs = fn(R_dev, U, V)
+        return (U, V), errs
+
+    (U, V), errs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_fit_fn(
+            mesh, dataclasses.replace(config, n_iterations=seg)),
+        run_seg=run_seg,
+        state0=(U_dev, V_dev),
+    )
+    return ALSResult(
+        U=jnp.asarray(U)[: config.m], V=jnp.asarray(V),
+        rmse_history=jnp.asarray(errs),
+    )

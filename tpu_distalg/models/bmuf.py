@@ -28,5 +28,9 @@ class BMUFConfig(local_sgd.LocalSGDConfig):
 
 
 def train(X_train, y_train, X_test, y_test, mesh: Mesh,
-          config: BMUFConfig = BMUFConfig()) -> TrainResult:
-    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config)
+          config: BMUFConfig = BMUFConfig(), *,
+          checkpoint_dir: str | None = None,
+          checkpoint_every: int = 100) -> TrainResult:
+    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config,
+                           checkpoint_dir=checkpoint_dir,
+                           checkpoint_every=checkpoint_every)

@@ -130,7 +130,7 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
         )
         return jnp.broadcast_to(mask, (L, n_padded))
 
-    def train(X, y, valid, X_test, y_test, w0, ws0, delta0):
+    def train(X, y, valid, X_test, y_test, w0, ws0, delta0, t0=0):
         def round_step(carry, t):
             w, ws, delta = carry
             masks = round_masks(valid, t)
@@ -152,10 +152,13 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
             )
             return (w, ws, delta), acc
 
+        # absolute round ids (t0 offset): segmented checkpoint/resume
+        # draws identical minibatch masks to a straight-through run
         (w, ws, delta), accs = jax.lax.scan(
-            round_step, (w0, ws0, delta0), jnp.arange(config.n_iterations)
+            round_step, (w0, ws0, delta0),
+            jnp.arange(config.n_iterations) + t0,
         )
-        return w, ws, accs
+        return w, ws, delta, accs
 
     return jax.jit(train)
 
@@ -163,7 +166,19 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
 def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: LocalSGDConfig = LocalSGDConfig(),
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 100,
 ) -> TrainResult:
+    """End-to-end local-update training; optionally checkpointed.
+
+    With ``checkpoint_dir``, rounds run in compiled segments and the
+    full carry ``(w, ws, delta)`` — center model, per-replica models and
+    the BMUF momentum — is saved after each (same machinery as SSGD,
+    ``utils.checkpoint.run_segmented``); segmented and straight-through
+    runs are bitwise-identical because round PRNG keys use absolute
+    round ids.
+    """
     Xs = parallelize(X_train, mesh)
     ys = parallelize(y_train, mesh)
     D = X_train.shape[1]
@@ -180,9 +195,38 @@ def train(
         )
     else:
         delta0 = jnp.zeros((D,))
-    fn = make_train_fn(mesh, config, Xs.n_padded)
-    w, ws, accs = fn(
-        Xs.data, ys.data, Xs.mask,
-        jnp.asarray(X_test), jnp.asarray(y_test), w0, ws0, delta0,
+    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+
+    if checkpoint_dir is None:
+        fn = make_train_fn(mesh, config, Xs.n_padded)
+        w, ws, _, accs = fn(
+            Xs.data, ys.data, Xs.mask, X_te, y_te, w0, ws0, delta0,
+        )
+        return TrainResult(w=w, ws=ws, accs=accs)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    ws_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def run_seg(fn, state, t0):
+        w, ws, delta = state
+        # restored per-replica models arrive as host arrays — re-shard
+        ws = jax.device_put(jnp.asarray(ws), ws_sharding)
+        w, ws, delta, accs = fn(
+            Xs.data, ys.data, Xs.mask, X_te, y_te,
+            jnp.asarray(w), ws, jnp.asarray(delta), t0=t0,
+        )
+        return (w, ws, delta), accs
+
+    (w, ws, delta), accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn(
+            mesh, dataclasses.replace(config, n_iterations=seg),
+            Xs.n_padded),
+        run_seg=run_seg,
+        state0=(w0, ws0, delta0),
     )
-    return TrainResult(w=w, ws=ws, accs=accs)
+    return TrainResult(
+        w=jnp.asarray(w), ws=jnp.asarray(ws), accs=jnp.asarray(accs)
+    )

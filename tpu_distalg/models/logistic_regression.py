@@ -55,7 +55,9 @@ def make_train_fn(mesh: Mesh, config: LRConfig):
         out_specs=(P(), P()),
     )
 
-    def train(X, y, valid, X_test, y_test, w0):
+    def train(X, y, valid, X_test, y_test, w0, t0=0):
+        del t0  # full-batch GD is PRNG-free; kept for segment symmetry
+
         def step(w, _t):
             g, _ = grad_fn(X, y, valid, w)
             w = w - config.eta * g  # logistic_regression.py:84 — raw sum
@@ -73,15 +75,33 @@ def make_train_fn(mesh: Mesh, config: LRConfig):
 def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: LRConfig = LRConfig(),
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
 ) -> TrainResult:
-    """End-to-end: shard data, compile the loop, run, return weights + accs."""
+    """End-to-end: shard data, compile the loop, run, return weights + accs.
+
+    ``checkpoint_dir`` enables segmented resume (carry = w; full-batch
+    GD is deterministic, so segmented ≡ straight bitwise)."""
     Xs = parallelize(X_train, mesh)
     ys = parallelize(y_train, mesh)
     w0 = logistic.init_weights(
         prng.root_key(config.init_seed), X_train.shape[1]
     )
-    fn = make_train_fn(mesh, config)
-    w, accs = fn(
-        Xs.data, ys.data, Xs.mask, jnp.asarray(X_test), jnp.asarray(y_test), w0
+    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+    if checkpoint_dir is None:
+        fn = make_train_fn(mesh, config)
+        w, accs = fn(Xs.data, ys.data, Xs.mask, X_te, y_te, w0)
+        return TrainResult(w=w, accs=accs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    w, accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn(
+            mesh, dataclasses.replace(config, n_iterations=seg)),
+        run_seg=lambda fn, w, t0: fn(
+            Xs.data, ys.data, Xs.mask, X_te, y_te, jnp.asarray(w), t0=t0),
+        state0=w0,
     )
-    return TrainResult(w=w, accs=accs)
+    return TrainResult(w=jnp.asarray(w), accs=jnp.asarray(accs))

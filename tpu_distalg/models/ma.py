@@ -24,5 +24,9 @@ class MAConfig(local_sgd.LocalSGDConfig):
 
 
 def train(X_train, y_train, X_test, y_test, mesh: Mesh,
-          config: MAConfig = MAConfig()) -> TrainResult:
-    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config)
+          config: MAConfig = MAConfig(), *,
+          checkpoint_dir: str | None = None,
+          checkpoint_every: int = 100) -> TrainResult:
+    return local_sgd.train(X_train, y_train, X_test, y_test, mesh, config,
+                           checkpoint_dir=checkpoint_dir,
+                           checkpoint_every=checkpoint_every)

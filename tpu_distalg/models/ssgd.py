@@ -426,49 +426,16 @@ def train(
 
     from tpu_distalg.utils import checkpoint as ckpt
 
-    start = 0
-    accs_parts = []
-    w = w0
-    if ckpt.latest_step(checkpoint_dir) is not None:
-        state, start = ckpt.restore(checkpoint_dir)
-        if start > config.n_iterations:
-            raise ValueError(
-                f"checkpoint in {checkpoint_dir} is at step {start}, past "
-                f"n_iterations={config.n_iterations}; use a fresh "
-                f"directory or raise n_iterations"
-            )
-        w = jnp.asarray(state["w"])
-        accs_parts = [np.asarray(state["accs"])]
-
-    seg_fns = {}
-    t = start
-    while t < config.n_iterations:
-        seg = min(checkpoint_every, config.n_iterations - t)
-        if seg not in seg_fns:
-            seg_fns[seg] = make_train_fn(
-                mesh, dataclasses.replace(config, n_iterations=seg),
-                Xs.n_padded,
-            )
-        w, accs = seg_fns[seg](
-            X_data, ys.data, Xs.mask, X_te, y_te, w, t0=t
-        )
-        if not bool(jnp.all(jnp.isfinite(w))):
-            raise FloatingPointError(
-                f"non-finite weights after step {t + seg} — check eta/"
-                f"regularisation (guard absent in the reference)"
-            )
-        t += seg
-        accs_parts.append(np.asarray(accs))
-        ckpt.save(
-            checkpoint_dir,
-            {"w": np.asarray(w),
-             "accs": np.concatenate(accs_parts)},
-            step=t,
-        )
-        ckpt.prune(checkpoint_dir, keep=3)
-    all_accs = (jnp.concatenate([jnp.asarray(a) for a in accs_parts])
-                if accs_parts else jnp.zeros((0,)))
-    return TrainResult(w=w[:d_orig], accs=all_accs)
+    w, accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn(
+            mesh, dataclasses.replace(config, n_iterations=seg),
+            Xs.n_padded),
+        run_seg=lambda fn, w, t0: fn(
+            X_data, ys.data, Xs.mask, X_te, y_te, jnp.asarray(w), t0=t0),
+        state0=w0,
+    )
+    return TrainResult(w=jnp.asarray(w)[:d_orig], accs=jnp.asarray(accs))
 
 
 def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
@@ -600,15 +567,16 @@ def _train_fused(
     (d_total,) weight vector; eval pads X_test with matching zero columns
     (the y/v entries of w are held at zero each step, so the padded
     matvec equals the unpadded one).
+
+    With ``checkpoint_dir``, training runs in compiled segments exactly
+    like the XLA-sampler path: the only carry is the augmented weight
+    vector, and both fused samplers key their PRNG off the ABSOLUTE step
+    id (on-core seed ``t + seed`` for 'fused', ``fold_in(key, t)`` for
+    'fused_gather'), so segmented resume is bitwise-equal to a straight
+    run.
     """
     import numpy as np
 
-    if checkpoint_dir is not None:
-        raise NotImplementedError(
-            "checkpointing composes with the XLA samplers; run "
-            "sampler='fused' without checkpoint_dir (its packed state "
-            "is a pure function of the inputs)"
-        )
     d_orig = X_train.shape[1]
     fn, X2, w0, meta = prepare_fused(X_train, y_train, mesh, config)
     X_te = jnp.asarray(
@@ -617,5 +585,18 @@ def _train_fused(
     )
     y_te = jnp.asarray(y_test)
     dummy = jnp.zeros((1,), jnp.float32)
-    w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
-    return TrainResult(w=w[:d_orig], accs=accs)
+    if checkpoint_dir is None:
+        w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
+        return TrainResult(w=w[:d_orig], accs=accs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    w, accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn_fused(
+            mesh, dataclasses.replace(config, n_iterations=seg), meta),
+        run_seg=lambda f, w, t0: f(
+            X2, dummy, dummy, X_te, y_te, jnp.asarray(w), t0=t0),
+        state0=w0,
+    )
+    return TrainResult(w=jnp.asarray(w)[:d_orig], accs=jnp.asarray(accs))

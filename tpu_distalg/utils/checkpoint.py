@@ -68,6 +68,93 @@ def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
     return tree, step
 
 
+def run_segmented(
+    checkpoint_dir: str,
+    checkpoint_every: int,
+    n_iterations: int,
+    make_seg_fn,
+    run_seg,
+    state0,
+    *,
+    keep: int = 3,
+):
+    """Generic segmented/resumable training loop — the machinery behind
+    every workload's ``checkpoint_dir`` option.
+
+    Runs ``n_iterations`` total steps as compiled segments of
+    ``checkpoint_every``; after each segment the (state, accs-so-far) is
+    saved and a non-finite guard trips with a clear error. An existing
+    checkpoint resumes from its absolute step; because every builder
+    threads the absolute step offset into its PRNG (``t0``), segmented
+    and straight-through runs are bitwise-identical.
+
+    ``make_seg_fn(seg_len)`` builds (and caches per distinct length) the
+    compiled segment; ``run_seg(fn, state, t0)`` executes it and returns
+    ``(new_state, accs)``; ``state0`` is the initial carry pytree.
+    Returns ``(state, accs_concat, start_step)``.
+    """
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
+    leaves0, treedef = jax.tree.flatten(state0)
+    start = 0
+    accs_parts = []
+    state = state0
+    if latest_step(checkpoint_dir) is not None:
+        payload, start = restore(checkpoint_dir)
+        if start > n_iterations:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} is at step {start}, "
+                f"past n_iterations={n_iterations}; use a fresh "
+                f"directory or raise n_iterations"
+            )
+        if "state" not in payload or len(payload["state"]) != len(leaves0):
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} has an incompatible "
+                f"format (expected {len(leaves0)} state leaves under "
+                f"'state'); it was written by a different workload or "
+                f"framework version — use a fresh directory"
+            )
+        state = jax.tree.unflatten(
+            treedef, [np.asarray(v) for v in payload["state"]]
+        )
+        accs_parts = [np.asarray(payload["accs"])]
+
+    import jax.numpy as jnp
+
+    seg_fns = {}
+    t = start
+    while t < n_iterations:
+        seg = min(checkpoint_every, n_iterations - t)
+        if seg not in seg_fns:
+            seg_fns[seg] = make_seg_fn(seg)
+        state, accs = run_seg(seg_fns[seg], state, t)
+        finite = all(
+            bool(jnp.all(jnp.isfinite(leaf)))
+            for leaf in jax.tree.leaves(state)
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        )
+        if not finite:
+            raise FloatingPointError(
+                f"non-finite training state after step {t + seg} — "
+                f"check eta/regularisation (guard absent in the "
+                f"reference)"
+            )
+        t += seg
+        accs_parts.append(np.asarray(accs))
+        save(
+            checkpoint_dir,
+            {"state": [np.asarray(x) for x in jax.tree.leaves(state)],
+             "accs": np.concatenate(accs_parts)},
+            step=t,
+        )
+        prune(checkpoint_dir, keep=keep)
+    accs = (np.concatenate(accs_parts) if accs_parts
+            else np.zeros((0,), np.float32))
+    return state, accs, start
+
+
 def prune(ckpt_dir: str, keep: int = 3) -> None:
     """Delete all but the newest ``keep`` checkpoints."""
     if not os.path.isdir(ckpt_dir):
