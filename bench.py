@@ -268,7 +268,15 @@ def _bench_pagerank(mesh, n_chips):
     }), flush=True)
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="bench")
+    parser.add_argument("--profile", type=str, default=None, metavar="DIR",
+                        help="capture a jax.profiler device trace of the "
+                             "benchmarked runs into DIR")
+    args = parser.parse_args(argv)
+
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
 
@@ -278,10 +286,13 @@ def main():
     n_chips = len(jax.devices())
     on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
 
-    _bench_ssgd(mesh, on_tpu, n_chips)
-    if on_tpu:
-        _bench_ssgd_scale(mesh, n_chips)
-    _bench_pagerank(mesh, n_chips)
+    from tpu_distalg.utils import profiling
+
+    with profiling.maybe_trace(args.profile):
+        _bench_ssgd(mesh, on_tpu, n_chips)
+        if on_tpu:
+            _bench_ssgd_scale(mesh, n_chips)
+        _bench_pagerank(mesh, n_chips)
 
 
 if __name__ == "__main__":

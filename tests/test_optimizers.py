@@ -19,7 +19,9 @@ def test_ssgd_converges(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500),
     )
-    assert res.final_acc >= 0.90, res.final_acc
+    # measured deterministic result 0.9415 (pinned seeds) — above the
+    # reference golden 0.9298; floor leaves ~1pt for platform drift
+    assert res.final_acc >= 0.93, res.final_acc
     assert res.accs.shape == (1500,)
 
 
@@ -29,7 +31,7 @@ def test_ssgd_with_l2(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500, lam=1e-4, reg_type="l2"),
     )
-    assert res.final_acc >= 0.88
+    assert res.final_acc >= 0.93  # measured 0.9415 deterministic
 
 
 def test_full_batch_lr_converges(mesh8, cancer_data):
@@ -38,7 +40,8 @@ def test_full_batch_lr_converges(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         logistic_regression.LRConfig(n_iterations=1500),
     )
-    assert res.final_acc >= 0.92, res.final_acc
+    # measured 0.9415 = the reference golden exactly (logistic_regression.py:109)
+    assert res.final_acc >= 0.93, res.final_acc
 
 
 def test_ma_converges(mesh4, cancer_data):
@@ -49,7 +52,8 @@ def test_ma_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         ma.MAConfig(n_iterations=300),
     )
-    assert res.final_acc >= 0.83, res.final_acc
+    # measured 0.9298 deterministic — well above the golden 0.8538
+    assert res.final_acc >= 0.90, res.final_acc
 
 
 def test_bmuf_converges(mesh4, cancer_data):
@@ -58,7 +62,7 @@ def test_bmuf_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         bmuf.BMUFConfig(n_iterations=300),
     )
-    assert res.final_acc >= 0.88, res.final_acc
+    assert res.final_acc >= 0.92, res.final_acc  # measured 0.9415; golden 0.9298
 
 
 def test_easgd_converges(mesh4, cancer_data):
@@ -67,7 +71,7 @@ def test_easgd_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         easgd.EASGDConfig(n_iterations=1500),
     )
-    assert res.final_acc >= 0.88, res.final_acc
+    assert res.final_acc >= 0.92, res.final_acc  # measured 0.9298 = golden
 
 
 def test_ssgd_topology_independence(mesh1, mesh8, cancer_data):
@@ -100,7 +104,7 @@ def test_ssgd_fixed_sampler(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500, sampler="fixed"),
     )
-    assert res.final_acc >= 0.88, res.final_acc
+    assert res.final_acc >= 0.89, res.final_acc  # measured 0.9006 deterministic
 
 
 def test_ssgd_fused_gather_sampler(mesh4, cancer_data):

@@ -60,6 +60,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="tpu_distalg")
     parser.add_argument("--emulate", type=int, default=0, metavar="N",
                         help="run on N virtual CPU devices")
+    parser.add_argument("--profile", type=str, default=None, metavar="DIR",
+                        help="capture a jax.profiler device trace of the "
+                             "run into DIR (TensorBoard / Perfetto)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("lr", help="full-batch logistic regression")
@@ -98,6 +101,12 @@ def main(argv=None):
     p.add_argument("--n-vertices", type=int, default=0,
                    help="0 = the reference's 4-edge toy graph; else an "
                         "Erdős–Rényi graph of this many vertices")
+    p.add_argument("--edge-file", type=str, default=None,
+                   help="load the graph from a '#'-commented whitespace "
+                        "edge-list file (overrides --n-vertices); parsed "
+                        "by the native C++ ingest runtime")
+    p.add_argument("--edge-capacity", type=int, default=1 << 24,
+                   help="max edges the file parser may return")
 
     p = sub.add_parser("closure", help="transitive closure")
     p.add_argument("--n-slices", type=int, default=0)
@@ -134,6 +143,13 @@ def main(argv=None):
 
     import jax  # after emulation setup
 
+    from tpu_distalg.utils import profiling
+
+    with profiling.maybe_trace(args.profile):
+        return _dispatch(args, jax)
+
+
+def _dispatch(args, jax):
     if args.cmd in ("lr", "ssgd", "ma", "bmuf", "easgd"):
         from tpu_distalg.utils import datasets
 
@@ -200,8 +216,15 @@ def main(argv=None):
         from tpu_distalg.models import pagerank as m
         from tpu_distalg.utils import datasets
 
-        edges = (datasets.toy_graph_edges() if args.n_vertices == 0
-                 else datasets.erdos_renyi_edges(args.n_vertices))
+        if args.edge_file is not None:
+            from tpu_distalg import native
+
+            edges = native.parse_edges_text(
+                args.edge_file, args.edge_capacity)
+        elif args.n_vertices == 0:
+            edges = datasets.toy_graph_edges()
+        else:
+            edges = datasets.erdos_renyi_edges(args.n_vertices)
         t0 = time.perf_counter()
         res = m.run(edges, _mesh(args), m.PageRankConfig(
             n_iterations=args.n_iterations, q=args.q, mode=args.mode))

@@ -158,6 +158,7 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
             mesh, dataclasses.replace(config, n_iterations=seg)),
         run_seg=run_seg,
         state0=(U_dev, V_dev),
+        tag="als",
     )
     return ALSResult(
         U=jnp.asarray(U)[: config.m], V=jnp.asarray(V),

@@ -226,6 +226,7 @@ def train(
             Xs.n_padded),
         run_seg=run_seg,
         state0=(w0, ws0, delta0),
+        tag=f"local_sgd:{config.global_update}",
     )
     return TrainResult(
         w=jnp.asarray(w), ws=jnp.asarray(ws), accs=jnp.asarray(accs)

@@ -103,5 +103,6 @@ def train(
         run_seg=lambda fn, w, t0: fn(
             Xs.data, ys.data, Xs.mask, X_te, y_te, jnp.asarray(w), t0=t0),
         state0=w0,
+        tag="lr",
     )
     return TrainResult(w=jnp.asarray(w), accs=jnp.asarray(accs))

@@ -434,6 +434,7 @@ def train(
         run_seg=lambda fn, w, t0: fn(
             X_data, ys.data, Xs.mask, X_te, y_te, jnp.asarray(w), t0=t0),
         state0=w0,
+        tag=f"ssgd:{config.sampler}",
     )
     return TrainResult(w=jnp.asarray(w)[:d_orig], accs=jnp.asarray(accs))
 
@@ -598,5 +599,6 @@ def _train_fused(
         run_seg=lambda f, w, t0: f(
             X2, dummy, dummy, X_te, y_te, jnp.asarray(w), t0=t0),
         state0=w0,
+        tag=f"ssgd:{config.sampler}",
     )
     return TrainResult(w=jnp.asarray(w)[:d_orig], accs=jnp.asarray(accs))

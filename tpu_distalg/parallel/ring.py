@@ -98,7 +98,11 @@ def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
     (S, H_local, d) head-sharded, in one all_to_all over the axis."""
     n = lax.axis_size(axis_name)
     s_l, h, d = x.shape
-    assert h % n == 0, f"heads {h} must divide axis size {n}"
+    if h % n:
+        raise ValueError(
+            f"alltoall_seq_to_head: head count {h} must be divisible by "
+            f"the '{axis_name}' axis size {n}"
+        )
     x = x.reshape(s_l, n, h // n, d)
     out = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
                          tiled=False)

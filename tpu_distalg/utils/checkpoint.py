@@ -76,6 +76,7 @@ def run_segmented(
     run_seg,
     state0,
     *,
+    tag: str = "",
     keep: int = 3,
 ):
     """Generic segmented/resumable training loop — the machinery behind
@@ -91,7 +92,11 @@ def run_segmented(
     ``make_seg_fn(seg_len)`` builds (and caches per distinct length) the
     compiled segment; ``run_seg(fn, state, t0)`` executes it and returns
     ``(new_state, accs)``; ``state0`` is the initial carry pytree.
-    Returns ``(state, accs_concat, start_step)``.
+    ``tag`` names the workload — stored in every checkpoint and compared
+    on resume (along with the state leaves' shapes/dtypes), so resuming
+    the wrong workload's directory fails loudly instead of silently
+    continuing from foreign weights. Returns
+    ``(state, accs_concat, start_step)``.
     """
     if checkpoint_every < 1:
         raise ValueError(
@@ -109,12 +114,20 @@ def run_segmented(
                 f"past n_iterations={n_iterations}; use a fresh "
                 f"directory or raise n_iterations"
             )
-        if "state" not in payload or len(payload["state"]) != len(leaves0):
+        saved_tag = np.asarray(
+            payload.get("tag", np.zeros(0, np.uint8))
+        ).tobytes().decode(errors="replace")
+        sig = [(tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
+               for v in payload.get("state", [])]
+        want = [(tuple(np.asarray(x).shape), str(np.asarray(x).dtype))
+                for x in leaves0]
+        if "state" not in payload or saved_tag != tag or sig != want:
             raise ValueError(
-                f"checkpoint in {checkpoint_dir} has an incompatible "
-                f"format (expected {len(leaves0)} state leaves under "
-                f"'state'); it was written by a different workload or "
-                f"framework version — use a fresh directory"
+                f"checkpoint in {checkpoint_dir} is incompatible: it "
+                f"holds workload {saved_tag!r} with state {sig}, but "
+                f"this run is {tag!r} with state {want} — it was "
+                f"written by a different workload, config, or framework "
+                f"version; use a fresh directory"
             )
         state = jax.tree.unflatten(
             treedef, [np.asarray(v) for v in payload["state"]]
@@ -145,7 +158,9 @@ def run_segmented(
         accs_parts.append(np.asarray(accs))
         save(
             checkpoint_dir,
-            {"state": [np.asarray(x) for x in jax.tree.leaves(state)],
+            # msgpack round-trips arrays, not str — byte-encode the tag
+            {"tag": np.frombuffer(tag.encode(), dtype=np.uint8),
+             "state": [np.asarray(x) for x in jax.tree.leaves(state)],
              "accs": np.concatenate(accs_parts)},
             step=t,
         )

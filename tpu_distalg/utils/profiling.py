@@ -14,6 +14,12 @@ import time
 import jax
 
 
+def maybe_trace(logdir):
+    """``trace(logdir)`` when a directory is given, else a no-op context —
+    the one-liner behind every ``--profile DIR`` flag."""
+    return trace(logdir) if logdir else contextlib.nullcontext()
+
+
 @contextlib.contextmanager
 def trace(logdir: str):
     """Capture a device trace viewable in TensorBoard / Perfetto:
