@@ -20,8 +20,10 @@ def test_ssgd_converges(mesh8, cancer_data):
         ssgd.SSGDConfig(n_iterations=1500),
     )
     # measured deterministic result 0.9415 (pinned seeds) — above the
-    # reference golden 0.9298; floor leaves ~1pt for platform drift
-    assert res.final_acc >= 0.93, res.final_acc
+    # reference golden 0.9298; the floor leaves ~2pts (≈4 flipped test
+    # samples of 171) for platform numeric drift while still failing a
+    # 4-point regression
+    assert res.final_acc >= 0.92, res.final_acc
     assert res.accs.shape == (1500,)
 
 
@@ -31,7 +33,7 @@ def test_ssgd_with_l2(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500, lam=1e-4, reg_type="l2"),
     )
-    assert res.final_acc >= 0.93  # measured 0.9415 deterministic
+    assert res.final_acc >= 0.92  # measured 0.9415 deterministic
 
 
 def test_full_batch_lr_converges(mesh8, cancer_data):
@@ -41,7 +43,7 @@ def test_full_batch_lr_converges(mesh8, cancer_data):
         logistic_regression.LRConfig(n_iterations=1500),
     )
     # measured 0.9415 = the reference golden exactly (logistic_regression.py:109)
-    assert res.final_acc >= 0.93, res.final_acc
+    assert res.final_acc >= 0.92, res.final_acc
 
 
 def test_ma_converges(mesh4, cancer_data):
@@ -71,7 +73,7 @@ def test_easgd_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         easgd.EASGDConfig(n_iterations=1500),
     )
-    assert res.final_acc >= 0.92, res.final_acc  # measured 0.9298 = golden
+    assert res.final_acc >= 0.91, res.final_acc  # measured 0.9298 = golden
 
 
 def test_ssgd_topology_independence(mesh1, mesh8, cancer_data):

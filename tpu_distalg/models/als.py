@@ -95,7 +95,10 @@ def make_fit_fn(mesh: Mesh, config: ALSConfig):
             V = linalg.solve_factor_block(G_u, U, R.T)
             if v_sharding is not None:
                 V = lax.with_sharding_constraint(V, v_sharding)
-            diff = R - U @ V.T  # padded rows are exactly zero on both sides
+            # padded rows are exactly zero on both sides; 'highest'
+            # precision keeps the reconstruction error measurement from
+            # being floored by TPU bf16 matmul passes
+            diff = R - jnp.matmul(U, V.T, precision=lax.Precision.HIGHEST)
             err = jnp.sqrt(jnp.sum(diff * diff) / denom)  # :19-21
             return (U, V), err
 

@@ -13,6 +13,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# ALS solves are precision-sensitive: on TPU the DEFAULT f32 matmul runs
+# as bf16 passes, which floors the recoverable rmse at ~0.03 where the
+# reference's float64 NumPy reaches ~1e-4 on an exactly-rank-k target.
+# Normal-equation products therefore pin precision to 'highest' (f32
+# accumulation on the MXU); the bandwidth cost is irrelevant at k×k scale.
+_HI = jax.lax.Precision.HIGHEST
+
 
 def gram(F: jax.Array, lam: float, reg_rows: int) -> jax.Array:
     """``FᵀF + λ·reg_rows·I`` — the ridge-regularised Gram.
@@ -22,7 +29,8 @@ def gram(F: jax.Array, lam: float, reg_rows: int) -> jax.Array:
     *row count of the factor matrix*, not per-row rating counts.
     """
     k = F.shape[1]
-    return F.T @ F + lam * reg_rows * jnp.eye(k, dtype=F.dtype)
+    FtF = jnp.matmul(F.T, F, precision=_HI)
+    return FtF + lam * reg_rows * jnp.eye(k, dtype=F.dtype)
 
 
 def solve_factor_block(G: jax.Array, F: jax.Array, R_block: jax.Array):
@@ -32,12 +40,12 @@ def solve_factor_block(G: jax.Array, F: jax.Array, R_block: jax.Array):
     the reference's per-row ``np.linalg.solve(XtX, Xty)`` but with the
     right-hand sides batched as a matrix: ``(k, rows)``.
     """
-    rhs = F.T @ R_block.T  # (k, rows_in_block)
+    rhs = jnp.matmul(F.T, R_block.T, precision=_HI)  # (k, rows_in_block)
     cho = jax.scipy.linalg.cho_factor(G)
     return jax.scipy.linalg.cho_solve(cho, rhs).T  # (rows_in_block, k)
 
 
 def rmse(R: jax.Array, U: jax.Array, V: jax.Array) -> jax.Array:
     """√(‖R − UVᵀ‖² / (m·n)) — ``matrix_decomposition.py:19-21``."""
-    diff = R - U @ V.T
+    diff = R - jnp.matmul(U, V.T, precision=_HI)
     return jnp.sqrt(jnp.sum(diff * diff) / (R.shape[0] * R.shape[1]))

@@ -114,9 +114,11 @@ def run_segmented(
                 f"past n_iterations={n_iterations}; use a fresh "
                 f"directory or raise n_iterations"
             )
-        saved_tag = np.asarray(
-            payload.get("tag", np.zeros(0, np.uint8))
-        ).tobytes().decode(errors="replace")
+        if "tag" in payload:
+            saved_tag = np.asarray(
+                payload["tag"]).tobytes().decode(errors="replace")
+        else:
+            saved_tag = tag  # pre-tag checkpoint format: shapes decide
         sig = [(tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
                for v in payload.get("state", [])]
         want = [(tuple(np.asarray(x).shape), str(np.asarray(x).dtype))
