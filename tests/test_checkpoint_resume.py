@@ -196,3 +196,17 @@ def test_checkpoint_every_validated(mesh8, data, tmp_path):
         ssgd.train(X_train, y_train, X_test, y_test, mesh8,
                    ssgd.SSGDConfig(n_iterations=20),
                    checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=0)
+
+
+def test_segmented_with_eval_every(mesh8, data, tmp_path):
+    """eval_every>1 across segment boundaries: the carried last-acc is
+    checkpointed, so segmented == straight including the held values."""
+    X_train, y_train, X_test, y_test = data
+    cfg = ssgd.SSGDConfig(n_iterations=100, eval_every=7)
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg)
+    seg = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg,
+                     checkpoint_dir=str(tmp_path / "ee"),
+                     checkpoint_every=40)
+    np.testing.assert_array_equal(np.asarray(straight.w), np.asarray(seg.w))
+    np.testing.assert_array_equal(
+        np.asarray(straight.accs), np.asarray(seg.accs))

@@ -150,3 +150,18 @@ def test_ssgd_feature_sharded_invalid_combos(mesh_2x4, cancer_data):
         ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
                    ssgd.SSGDConfig(n_iterations=5, feature_sharded=True,
                                    sampler="fixed"))
+
+
+def test_ssgd_eval_every(mesh8, cancer_data):
+    """eval_every=N computes accuracy every Nth step (holding the last
+    value between), and the trajectory is identical to eval_every=1."""
+    X_train, y_train, X_test, y_test = cancer_data
+    dense = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                       ssgd.SSGDConfig(n_iterations=40))
+    sparse = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                        ssgd.SSGDConfig(n_iterations=40, eval_every=10))
+    np.testing.assert_array_equal(np.asarray(dense.w), np.asarray(sparse.w))
+    da, sa = np.asarray(dense.accs), np.asarray(sparse.accs)
+    # step ids run t=0..39; eval fires at t % 10 == 0 → indices 0,10,20,30
+    for i in range(40):
+        np.testing.assert_allclose(sa[i], da[(i // 10) * 10])
