@@ -97,3 +97,24 @@ def test_dedupe_edges_pair_contiguous():
     expect = np.unique(edges, axis=0)
     np.testing.assert_array_equal(src, expect[:, 0])
     np.testing.assert_array_equal(dst, expect[:, 1])
+
+
+def test_counting_sort_perm_matches_numpy():
+    from tpu_distalg import native
+
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1000, size=100_000)
+    got = native.counting_sort_perm(keys, 1000)
+    want = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_counting_sort_perm_rejects_out_of_range():
+    """Validation happens Python-side, so it holds with or without the
+    native library."""
+    from tpu_distalg import native
+
+    with pytest.raises(ValueError, match="out of range"):
+        native.counting_sort_perm(np.array([0, 5, 2]), 4)
+    with pytest.raises(ValueError, match="out of range"):
+        native.counting_sort_perm(np.array([-1, 0]), 4)

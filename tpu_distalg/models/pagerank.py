@@ -8,17 +8,23 @@ gathers ``ranks[src]``, scatters contributions into a dense rank vector via
 a single ``lax.scan``; the reference executes them as one 10-join-deep lazy
 lineage at collect time (SURVEY.md §3.4).
 
-TPU layout decisions (random HBM access is the enemy — a random 8M-element
-gather costs ~60 ms on one v5e chip, an unsorted scatter more):
+TPU layout decisions (random HBM access is the enemy — every random
+gather/scatter element costs ~10-15 ns on a v5e through XLA, and that —
+not bandwidth — bounds the sweep):
 
-  * edges are sorted by ``dst`` ONCE at prep, so the contribution scatter
-    is a ``segment_sum(indices_are_sorted=True)`` (sequential writes);
-    shards are contiguous slices of the sorted list, so per-shard
-    sortedness survives sharding, and padding uses dst=V-1 (order-
-    preserving, masked out);
   * ``inv_deg[src]`` never changes across iterations, so it is gathered
     once at prep into a static per-edge weight array — one random gather
-    per iteration (``ranks[src]``) instead of three.
+    per iteration (``ranks[src]``) instead of three, and standard mode
+    skips the ``received`` scatter entirely (together ~2.9× per sweep,
+    measured);
+  * edges are sorted by ``dst`` ONCE at prep (native C++ counting sort),
+    so the contribution scatter is a
+    ``segment_sum(indices_are_sorted=True)``; shards are contiguous
+    slices of the sorted list, so per-shard sortedness survives
+    sharding, and padding uses dst=V-1 (order-preserving, masked out).
+    Rejected alternatives, measured no faster: pull/ELL in-edge tables
+    (doubles the random accesses) and prefix-sum segmented reduction
+    (f32 prefix differences can't resolve 1e-6-scale ranks).
 
 Two modes (SURVEY.md §7 hard part #6):
   * ``mode='reference'`` reproduces the reference's semantics exactly: n is
@@ -79,8 +85,11 @@ class DeviceEdges:
 
 
 def prepare_device_edges(el: gops.EdgeList, mesh: Mesh) -> DeviceEdges:
-    """One-time host prep: dst-sort, per-edge weight gather, pad, shard."""
-    order = np.argsort(el.dst, kind="stable")
+    """One-time host prep: dst-sort (native C++ counting sort), per-edge
+    weight gather, pad, shard."""
+    from tpu_distalg import native
+
+    order = native.counting_sort_perm(el.dst, el.n_vertices)
     src_o = el.src[order].astype(np.int32)
     dst_o = el.dst[order].astype(np.int32)
     deg = el.out_degree.astype(np.float32)

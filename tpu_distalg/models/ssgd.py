@@ -387,6 +387,21 @@ def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, grad_fn)
 
 
+def _acc_carrying_run_seg(*data_args):
+    """Segment runner shared by the XLA and fused checkpoint paths:
+    state = (w, last_acc); the final emitted accuracy IS the carried
+    last-acc, so resuming with ``acc0`` keeps eval_every>1 histories
+    bitwise-equal across segment boundaries."""
+
+    def run_seg(fn, state, t0):
+        w, acc0 = state
+        w, accs = fn(*data_args, jnp.asarray(w), t0=t0,
+                     acc0=jnp.asarray(acc0))
+        return (w, accs[-1]), accs
+
+    return run_seg
+
+
 def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: SSGDConfig = SSGDConfig(),
@@ -448,18 +463,13 @@ def train(
 
     from tpu_distalg.utils import checkpoint as ckpt
 
-    def run_seg(fn, state, t0):
-        w, acc0 = state
-        w, accs = fn(X_data, ys.data, Xs.mask, X_te, y_te,
-                     jnp.asarray(w), t0=t0, acc0=jnp.asarray(acc0))
-        return (w, accs[-1]), accs
-
     (w, _), accs, _ = ckpt.run_segmented(
         checkpoint_dir, checkpoint_every, config.n_iterations,
         make_seg_fn=lambda seg: make_train_fn(
             mesh, dataclasses.replace(config, n_iterations=seg),
             Xs.n_padded),
-        run_seg=run_seg,
+        run_seg=_acc_carrying_run_seg(
+            X_data, ys.data, Xs.mask, X_te, y_te),
         state0=(w0, jnp.float32(0)),
         tag=f"ssgd:{config.sampler}",
     )
@@ -619,17 +629,11 @@ def _train_fused(
 
     from tpu_distalg.utils import checkpoint as ckpt
 
-    def run_seg(f, state, t0):
-        w, acc0 = state
-        w, accs = f(X2, dummy, dummy, X_te, y_te, jnp.asarray(w),
-                    t0=t0, acc0=jnp.asarray(acc0))
-        return (w, accs[-1]), accs
-
     (w, _), accs, _ = ckpt.run_segmented(
         checkpoint_dir, checkpoint_every, config.n_iterations,
         make_seg_fn=lambda seg: make_train_fn_fused(
             mesh, dataclasses.replace(config, n_iterations=seg), meta),
-        run_seg=run_seg,
+        run_seg=_acc_carrying_run_seg(X2, dummy, dummy, X_te, y_te),
         state0=(w0, jnp.float32(0)),
         tag=f"ssgd:{config.sampler}",
     )

@@ -62,6 +62,9 @@ def load() -> ctypes.CDLL | None:
     lib.tda_parse_edges_text.argtypes = [ctypes.c_char_p, i64p, i64p,
                                          ctypes.c_int64]
     lib.tda_parse_edges_text.restype = ctypes.c_int64
+    lib.tda_counting_sort_perm.argtypes = [i64p, ctypes.c_int64,
+                                           ctypes.c_int64, i64p]
+    lib.tda_counting_sort_perm.restype = ctypes.c_int32
     _lib = lib
     return _lib
 
@@ -124,6 +127,28 @@ def csr_offsets(sorted_src: np.ndarray, n_vertices: int) -> np.ndarray:
     out = np.zeros((n_vertices + 1,), dtype=np.int64)
     lib.tda_csr_offsets(sorted_src, len(sorted_src), out, n_vertices)
     return out
+
+
+def counting_sort_perm(keys: np.ndarray, key_range: int) -> np.ndarray:
+    """Stable argsort of bounded integer keys — O(n + range) counting
+    sort in C++ (NumPy fallback: ``np.argsort(kind='stable')``). The
+    host-prep behind PageRank's dst-sorted edge layout."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if len(keys) and (keys.min() < 0 or keys.max() >= key_range):
+        # validate here (not only natively) so fallback environments
+        # reject corrupt ids the same way machines with the library do
+        raise ValueError(
+            f"counting_sort_perm: key out of range [0, {key_range})"
+        )
+    lib = load()
+    if lib is None or len(keys) == 0:
+        return np.argsort(keys, kind="stable")
+    perm = np.empty((len(keys),), dtype=np.int64)
+    if lib.tda_counting_sort_perm(keys, len(keys), key_range, perm):
+        raise ValueError(
+            f"counting_sort_perm: key out of range [0, {key_range})"
+        )
+    return perm
 
 
 def parse_edges_text(path: str, capacity: int) -> np.ndarray:

@@ -64,8 +64,15 @@ def scatter_add(values: jax.Array, dst: jax.Array, n: int, *,
     """``reduceByKey(add)`` over dense vertex ids: one XLA scatter-add.
 
     ``indices_sorted=True`` (caller guarantees dst is non-decreasing)
-    turns the random-access scatter into sequential writes — the
-    difference between ~115 ms and ~15 ms per 8M-edge sweep on a v5e.
+    lets XLA skip the out-of-order-update handling. Measured reality on
+    one v5e at 8M edges → 1M segments: the sweep is dominated by the
+    ~10-15 ns/element cost of any random-access gather/scatter XLA op
+    (sorted and unsorted scatter measure within noise of each other, and
+    a gather-only "pull"/ELL formulation is no faster — it doubles the
+    random accesses). The wins that do matter, measured: precomputing
+    the iteration-invariant ``inv_deg[src]`` per-edge weights (drops 2
+    of 3 gathers) and skipping the ``received`` scatter in standard mode
+    (drops 1 of 2 scatters) — together ~2.9× per sweep.
     """
     return jax.ops.segment_sum(values, dst, num_segments=n,
                                indices_are_sorted=indices_sorted)
