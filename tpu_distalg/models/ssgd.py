@@ -61,7 +61,11 @@ class SSGDConfig:
     # 'fused_gather' = the traffic-proportional kernel: sample whole
     # gather_block_rows-row blocks XLA-side, DMA ONLY those blocks
     # (≈frac× the HBM bytes of 'fused'; block-cluster sampling — i.i.d.
-    # per-row equivalent when rows are i.i.d. or pack-time shuffled)
+    # per-row equivalent when rows are i.i.d. or pack-time shuffled).
+    # Precision note: with x_dtype='bfloat16' the fused kernels cast the
+    # residual AND the selector-replicated weights to bf16 (the XLA bf16
+    # path keeps both f32) — a small extra deviation; convergence to the
+    # reference band is verified on-TPU (tests_tpu/, bench convergence_*)
     sampler: str = "bernoulli"
     fused_pack: int = 16        # rows packed per sublane row ('fused*')
     fused_block_rows: int = 8192

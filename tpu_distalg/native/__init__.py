@@ -134,14 +134,14 @@ def counting_sort_perm(keys: np.ndarray, key_range: int) -> np.ndarray:
     sort in C++ (NumPy fallback: ``np.argsort(kind='stable')``). The
     host-prep behind PageRank's dst-sorted edge layout."""
     keys = np.ascontiguousarray(keys, dtype=np.int64)
-    if len(keys) and (keys.min() < 0 or keys.max() >= key_range):
-        # validate here (not only natively) so fallback environments
-        # reject corrupt ids the same way machines with the library do
-        raise ValueError(
-            f"counting_sort_perm: key out of range [0, {key_range})"
-        )
     lib = load()
     if lib is None or len(keys) == 0:
+        # fallback validates too, so environments without a compiler
+        # reject corrupt ids exactly like the native path's range check
+        if len(keys) and (keys.min() < 0 or keys.max() >= key_range):
+            raise ValueError(
+                f"counting_sort_perm: key out of range [0, {key_range})"
+            )
         return np.argsort(keys, kind="stable")
     perm = np.empty((len(keys),), dtype=np.int64)
     if lib.tda_counting_sort_perm(keys, len(keys), key_range, perm):

@@ -77,6 +77,21 @@ def get_mesh(
     ``data=None`` uses every available device on the data axis (after
     dividing out ``model``). This is the stand-in for the per-script
     ``n_slices`` globals (``ssgd.py:17``): partition count == mesh data size.
+
+    Topology awareness (TPU, all devices used, none pinned explicitly):
+
+      * multi-slice (devices spanning >1 ``slice_index``): a DCN-hybrid
+        mesh via ``mesh_utils.create_hybrid_device_mesh`` — the data
+        axis spans slices over DCN (one gradient AllReduce per step
+        tolerates DCN latency) while the model axis stays inside a
+        slice so its per-matmul collectives ride ICI;
+      * single slice, >1 chip (covers multi-host pods too):
+        ``mesh_utils.create_device_mesh`` orders devices along the
+        physical ICI torus so neighbouring mesh coordinates are
+        neighbouring chips (ring collectives stay nearest-neighbour);
+      * otherwise (CPU emulation, one chip, explicit ``devices``, or a
+        shape the topology helpers cannot express): a plain row-major
+        grid — deterministic ordering for tests.
     """
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -87,6 +102,20 @@ def get_mesh(
     need = data * model
     if need > n:
         raise ValueError(f"mesh {data}x{model} needs {need} devices, have {n}")
+    if (devices is None and need == n and n > 1
+            and devs[0].platform == "tpu"):
+        from jax.experimental import mesh_utils
+
+        n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+        if n_slices > 1 and data % n_slices == 0:
+            grid = mesh_utils.create_hybrid_device_mesh(
+                (data // n_slices, model), (n_slices, 1)
+            )
+            return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+        if n_slices == 1:
+            grid = mesh_utils.create_device_mesh((data, model))
+            return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+        # multi-slice but data doesn't divide: row-major fallback below
     grid = np.array(devs[:need]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
