@@ -104,20 +104,12 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         args = (Xs.data, ys.data, Xs.mask, ev[0], ev[1])
         bytes_per_step = Xs.n_padded * d * 4 * 2  # f32, fwd+bwd passes
 
-    def run(w):
-        # NOTE: device timing via host fetch — on tunneled TPU backends
-        # block_until_ready can return before execution finishes
-        w2, _ = fn(*args, w)
-        np.asarray(w2)
-        return w2
+    from tpu_distalg.utils import profiling
 
-    w = run(w0)  # warmup / compile
-    best = 0.0
-    for _ in range(N_REPEATS):
-        t0 = time.perf_counter()
-        w = run(w)
-        dt = time.perf_counter() - t0
-        best = max(best, N_STEPS / dt)
+    # device timing via single-element host fetch (steps_per_sec) — on
+    # tunneled TPU backends block_until_ready can return early
+    best = profiling.steps_per_sec(
+        lambda: fn(*args, w0), steps=N_STEPS, repeats=N_REPEATS)
     per_chip = best / n_chips
 
     # measured baseline stand-in: identical update, driver-loop shape —
@@ -213,17 +205,13 @@ def _bench_ssgd_scale(mesh, n_chips):
     ev = (jnp.zeros((1, meta["d_total"]), jnp.float32),
           jnp.zeros((1,), jnp.float32))
 
-    def run(w):
-        w2, _ = fn(X2, dummy, dummy, ev[0], ev[1], w)
-        np.asarray(w2)
-        return w2
+    from tpu_distalg.utils import profiling
 
-    w = run(w0)
-    best = 0.0
-    for _ in range(N_REPEATS):
-        t0 = time.perf_counter()
-        w = run(w)
-        best = max(best, n_steps / (time.perf_counter() - t0))
+    best = profiling.steps_per_sec(
+        lambda: fn(X2, dummy, dummy, ev[0], ev[1], w0),
+        steps=n_steps, repeats=N_REPEATS)
+    # train once more to get weights for the held-out check
+    w, _ = fn(X2, dummy, dummy, ev[0], ev[1], w0)
 
     # held-out accuracy of the trained weights: fresh rows from the same
     # counter-based generator (ids beyond the training range) — proves
@@ -273,18 +261,12 @@ def _bench_pagerank(mesh, n_chips):
         n_iterations=PR_ITERS_PER_CALL, mode="standard")
     fn = pagerank.make_run_fn(mesh, cfg, de.n_vertices)
 
-    def run():
-        ranks, _ = fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
-                      de.n_ref)
-        np.asarray(ranks)
+    from tpu_distalg.utils import profiling
 
-    run()  # warmup / compile
-    best = 0.0
-    for _ in range(N_REPEATS):
-        t0 = time.perf_counter()
-        run()
-        dt = time.perf_counter() - t0
-        best = max(best, PR_ITERS_PER_CALL / dt)
+    best = profiling.steps_per_sec(
+        lambda: fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
+                   de.n_ref),
+        steps=PR_ITERS_PER_CALL, repeats=N_REPEATS)
     print(json.dumps({
         "metric": "pagerank_1m_iters_per_sec",
         "value": round(best / n_chips, 3),

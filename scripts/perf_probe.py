@@ -5,15 +5,12 @@ scale (same workload as bench.py: 1M rows, 125 features + bias → 128-wide
 packed matrix) so we can pick the fastest faithful path for bench.py.
 """
 
-import time
-
-import jax
 import jax.numpy as jnp
 
 from tpu_distalg.models import ssgd
 from tpu_distalg.ops import logistic
 from tpu_distalg.parallel import get_mesh, parallelize
-from tpu_distalg.utils import datasets, prng
+from tpu_distalg.utils import datasets, prng, profiling
 
 N_ROWS = 1 << 20
 N_FEATURES = 125  # +bias = 126; packed layout pads to 128 (bench.py)
@@ -26,15 +23,7 @@ def _data():
 
 
 def _time(run, w0):
-    w = run(w0)  # warmup / compile
-    jax.block_until_ready(w)
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        w = run(w)
-        jax.block_until_ready(w)
-        best = max(best, N_STEPS / (time.perf_counter() - t0))
-    return best
+    return profiling.steps_per_sec(run, w0, steps=N_STEPS)
 
 
 def probe(name, config):
@@ -81,3 +70,7 @@ if __name__ == "__main__":
     probe_fused("fused bf16",
                 C(n_iterations=N_STEPS, eval_test=False, sampler="fused",
                   x_dtype="bfloat16", init_seed=7))
+    probe_fused("fused_gather bf16",
+                C(n_iterations=N_STEPS, eval_test=False,
+                  sampler="fused_gather", gather_block_rows=8192,
+                  x_dtype="bfloat16", shuffle_seed=0, init_seed=7))

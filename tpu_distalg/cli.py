@@ -20,11 +20,13 @@ import time
 
 
 def _mesh(args):
-    import jax
+    from tpu_distalg.parallel import MeshContext
 
-    from tpu_distalg.parallel import get_mesh
-
-    return get_mesh(data=args.n_slices if args.n_slices > 0 else None)
+    # MeshContext is the SparkSession analogue: the one runtime object
+    # every workload receives (its .mesh)
+    return MeshContext.create(
+        data=args.n_slices if args.n_slices > 0 else None
+    ).mesh
 
 
 def _add_common(p, n_iterations, eta=None, frac=None):

@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_distalg.ops import logistic, sampling
-from tpu_distalg.parallel import DATA_AXIS, data_parallel, parallelize
+from tpu_distalg.parallel import (
+    DATA_AXIS,
+    data_parallel,
+    parallelize,
+    tree_allreduce_mean,
+)
 from tpu_distalg.utils import metrics, prng
 
 
@@ -68,7 +73,10 @@ class TrainResult:
 
 
 def _make_local_rounds(config: LocalSGDConfig):
-    """shard_map body: resync (maybe), run L local steps on the local shard."""
+    """shard_map body: resync (maybe), run L local steps on the local
+    shard, then pmean the round's model average across replicas — the
+    ``treeAggregate``/n combine (``ma.py:104-106``) as ONE collective
+    over the data axis, so the center update needs no gather."""
 
     def local_rounds(X, y, masks, ws_local, w):
         # X (rows, D) local block; masks (L, rows); ws_local (1, D); w (D,)
@@ -85,7 +93,7 @@ def _make_local_rounds(config: LocalSGDConfig):
             return w_l, None
 
         w_l, _ = jax.lax.scan(local_step, w_l, masks)
-        return w_l[None, :]
+        return w_l[None, :], tree_allreduce_mean(w_l)
 
     return local_rounds
 
@@ -110,7 +118,7 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
             P("data", None),   # per-replica models (R, D) → (1, D) local
             P(),               # center w
         ),
-        out_specs=P("data", None),
+        out_specs=(P("data", None), P()),
     )
 
     def round_masks(valid, t):
@@ -134,8 +142,7 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
         def round_step(carry, t):
             w, ws, delta = carry
             masks = round_masks(valid, t)
-            ws = local_fn(X, y, masks, ws, w)
-            w_avg = jnp.mean(ws, axis=0)  # treeAggregate/n ma.py:104-106
+            ws, w_avg = local_fn(X, y, masks, ws, w)
             if config.global_update == "average":
                 w = w_avg
             elif config.global_update == "bmuf":

@@ -107,15 +107,17 @@ def get_mesh(
         from jax.experimental import mesh_utils
 
         n_slices = len({getattr(d, "slice_index", 0) for d in devs})
-        if n_slices > 1 and data % n_slices == 0:
-            grid = mesh_utils.create_hybrid_device_mesh(
-                (data // n_slices, model), (n_slices, 1)
-            )
-            return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
-        if n_slices == 1:
-            grid = mesh_utils.create_device_mesh((data, model))
-            return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
-        # multi-slice but data doesn't divide: row-major fallback below
+        try:
+            if n_slices > 1 and data % n_slices == 0:
+                grid = mesh_utils.create_hybrid_device_mesh(
+                    (data // n_slices, model), (n_slices, 1)
+                )
+                return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+            if n_slices == 1:
+                grid = mesh_utils.create_device_mesh((data, model))
+                return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+        except (NotImplementedError, ValueError):
+            pass  # topology can't express the shape: row-major fallback
     grid = np.array(devs[:need]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 

@@ -38,13 +38,32 @@ def trace(logdir: str):
 def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
                   warmup: bool = True) -> float:
     """Best-of-``repeats`` throughput of ``fn(*args)``, where one call runs
-    ``steps`` device-side steps (e.g. a scan segment). Blocks on the result
-    each repeat, so dispatch-async bias is excluded."""
+    ``steps`` device-side steps (e.g. a scan segment) as ONE compiled
+    program. Completion is observed by fetching the program's first
+    output leaf to the host — on tunneled TPU backends
+    ``block_until_ready`` can return before execution finishes, which
+    silently turns a throughput number into a dispatch number, and every
+    host round-trip costs ~100 ms there, so exactly one small fetch is
+    made (one jit execution produces all outputs, so one leaf proves
+    completion of all of them). Huge leaves fetch a single element
+    instead (stays addressable on multi-host meshes)."""
+    import numpy as np
+
+    def fetch():
+        out = fn(*args)
+        leaf = jax.numpy.asarray(jax.tree.leaves(out)[0])
+        if leaf.size <= (1 << 20):
+            np.asarray(leaf)     # small: one plain D2H, no dispatch
+        else:
+            # large/sharded: fetch one element — the extra tiny dispatch
+            # beats shipping the whole buffer to the host
+            np.asarray(leaf[(0,) * leaf.ndim])
+
     if warmup:
-        jax.block_until_ready(fn(*args))
+        fetch()
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        fetch()
         best = min(best, time.perf_counter() - t0)
     return steps / best
