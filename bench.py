@@ -207,11 +207,9 @@ def _bench_ssgd_scale(mesh, n_chips):
 
     from tpu_distalg.utils import profiling
 
-    best = profiling.steps_per_sec(
+    best, (w, _) = profiling.steps_per_sec(
         lambda: fn(X2, dummy, dummy, ev[0], ev[1], w0),
-        steps=n_steps, repeats=N_REPEATS)
-    # train once more to get weights for the held-out check
-    w, _ = fn(X2, dummy, dummy, ev[0], ev[1], w0)
+        steps=n_steps, repeats=N_REPEATS, with_output=True)
 
     # held-out accuracy of the trained weights: fresh rows from the same
     # counter-based generator (ids beyond the training range) — proves

@@ -95,3 +95,17 @@ def test_cli_ssgd_short(tmp_path):
     import os
 
     assert os.path.exists(plot)
+
+
+def test_guard_finite():
+    import jax.numpy as jnp
+    import pytest
+
+    from tpu_distalg.utils import metrics
+
+    metrics.guard_finite((jnp.ones(3), jnp.zeros(2)), "ok state")
+    metrics.guard_finite(jnp.arange(3), "int state")  # ints pass through
+    with pytest.raises(FloatingPointError, match="bad state"):
+        metrics.guard_finite(jnp.array([1.0, jnp.nan]), "bad state")
+    with pytest.raises(FloatingPointError, match="inf"):
+        metrics.guard_finite((jnp.ones(2), jnp.array([jnp.inf])), "inf state")

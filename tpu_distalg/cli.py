@@ -65,6 +65,11 @@ def main(argv=None):
     parser.add_argument("--profile", type=str, default=None, metavar="DIR",
                         help="capture a jax.profiler device trace of the "
                              "run into DIR (TensorBoard / Perfetto)")
+    parser.add_argument("--multihost", action="store_true",
+                        help="initialise the multi-host runtime "
+                             "(jax.distributed over DCN) before building "
+                             "the mesh; run the same command on every "
+                             "host of the slice group")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("lr", help="full-batch logistic regression")
@@ -142,6 +147,11 @@ def main(argv=None):
         from tpu_distalg.parallel.mesh import emulate_devices
 
         emulate_devices(args.emulate)
+
+    if args.multihost:
+        from tpu_distalg.parallel.mesh import multihost_initialize
+
+        multihost_initialize()
 
     import jax  # after emulation setup
 

@@ -33,6 +33,7 @@ from tpu_distalg.parallel import (
     pad_rows,
     replicated_sharding,
 )
+from tpu_distalg.utils import metrics
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,6 +144,7 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
     if checkpoint_dir is None:
         fn = make_fit_fn(mesh, config)
         U, V, errs = fn(R_dev, U_dev, V_dev)
+        metrics.guard_finite(errs, "ALS rmse history")
         return ALSResult(U=U[: config.m], V=V, rmse_history=errs)
 
     from tpu_distalg.utils import checkpoint as ckpt

@@ -209,6 +209,7 @@ def train(
         w, ws, _, accs = fn(
             Xs.data, ys.data, Xs.mask, X_te, y_te, w0, ws0, delta0,
         )
+        metrics.guard_finite((w, ws), "local-SGD models")
         return TrainResult(w=w, ws=ws, accs=accs)
 
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -92,6 +92,7 @@ def train(
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config)
         w, accs = fn(Xs.data, ys.data, Xs.mask, X_te, y_te, w0)
+        metrics.guard_finite(w, "LR weights")
         return TrainResult(w=w, accs=accs)
 
     from tpu_distalg.utils import checkpoint as ckpt

@@ -463,6 +463,7 @@ def train(
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config, Xs.n_padded)
         w, accs = fn(X_data, ys.data, Xs.mask, X_te, y_te, w0)
+        metrics.guard_finite(w, "SSGD weights")
         return TrainResult(w=w[:d_orig], accs=accs)
 
     from tpu_distalg.utils import checkpoint as ckpt
@@ -629,6 +630,7 @@ def _train_fused(
     dummy = jnp.zeros((1,), jnp.float32)
     if checkpoint_dir is None:
         w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
+        metrics.guard_finite(w, "SSGD (fused) weights")
         return TrainResult(w=w[:d_orig], accs=accs)
 
     from tpu_distalg.utils import checkpoint as ckpt

@@ -59,9 +59,7 @@ def multihost_initialize(**kwargs) -> None:
     ``jax.distributed.initialize``, which it wraps). Idempotent: a no-op if
     the distributed client is already up.
     """
-    from jax._src import distributed as _dist
-
-    if _dist.global_state.client is not None:  # already initialised
+    if jax.distributed.is_initialized():
         return
     jax.distributed.initialize(**kwargs)
 

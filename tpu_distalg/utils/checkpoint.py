@@ -136,7 +136,7 @@ def run_segmented(
         )
         accs_parts = [np.asarray(payload["accs"])]
 
-    import jax.numpy as jnp
+    from tpu_distalg.utils import metrics
 
     seg_fns = {}
     t = start
@@ -145,17 +145,9 @@ def run_segmented(
         if seg not in seg_fns:
             seg_fns[seg] = make_seg_fn(seg)
         state, accs = run_seg(seg_fns[seg], state, t)
-        finite = all(
-            bool(jnp.all(jnp.isfinite(leaf)))
-            for leaf in jax.tree.leaves(state)
-            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        metrics.guard_finite(
+            state, f"training state after step {t + seg}"
         )
-        if not finite:
-            raise FloatingPointError(
-                f"non-finite training state after step {t + seg} — "
-                f"check eta/regularisation (guard absent in the "
-                f"reference)"
-            )
         t += seg
         accs_parts.append(np.asarray(accs))
         save(

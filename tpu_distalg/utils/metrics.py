@@ -22,6 +22,25 @@ def binary_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((pred == labels).astype(jnp.float32))
 
 
+def guard_finite(tree, context: str):
+    """Raise FloatingPointError if any floating leaf holds NaN/Inf — the
+    guard the reference lacks entirely (its unstable sigmoid can NaN
+    silently, SURVEY.md §5). Called on final model state by every
+    trainer; the checkpointed paths additionally guard every segment."""
+    import jax.numpy as jnp
+
+    for leaf in jax.tree.leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if (jnp.issubdtype(leaf.dtype, jnp.floating)
+                and not bool(jnp.all(jnp.isfinite(leaf)))):
+            raise FloatingPointError(
+                f"non-finite values in {context} — check eta/"
+                f"regularisation/input data (guard absent in the "
+                f"reference)"
+            )
+    return tree
+
+
 def ewma(values: np.ndarray, alpha: float = 0.9) -> np.ndarray:
     """EWMA with the reference's recurrence s[t] = α·s[t-1] + (1-α)·v[t],
     s[0] = v[0] (``ssgd.py:51-59``)."""

@@ -36,7 +36,7 @@ def trace(logdir: str):
 
 
 def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
-                  warmup: bool = True) -> float:
+                  warmup: bool = True, with_output: bool = False):
     """Best-of-``repeats`` throughput of ``fn(*args)``, where one call runs
     ``steps`` device-side steps (e.g. a scan segment) as ONE compiled
     program. Completion is observed by fetching the program's first
@@ -46,7 +46,11 @@ def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
     host round-trip costs ~100 ms there, so exactly one small fetch is
     made (one jit execution produces all outputs, so one leaf proves
     completion of all of them). Huge leaves fetch a single element
-    instead (stays addressable on multi-host meshes)."""
+    instead (stays addressable on multi-host meshes).
+
+    ``with_output=True`` returns ``(steps_per_sec, last_output)`` so a
+    caller that also wants the computed result (e.g. trained weights for
+    a convergence check) need not re-run the program."""
     import numpy as np
 
     def fetch():
@@ -58,12 +62,13 @@ def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
             # large/sharded: fetch one element — the extra tiny dispatch
             # beats shipping the whole buffer to the host
             np.asarray(leaf[(0,) * leaf.ndim])
+        return out
 
-    if warmup:
-        fetch()
+    out = fetch() if warmup else None
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        fetch()
+        out = fetch()
         best = min(best, time.perf_counter() - t0)
-    return steps / best
+    rate = steps / best
+    return (rate, out) if with_output else rate
