@@ -58,33 +58,72 @@ def ring_allgather_matmul(a_local, b_local, axis_name: str = DATA_AXIS):
 
 
 def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
-                   scale: float | None = None):
+                   scale: float | None = None,
+                   kv_chunk: int | None = None):
     """Exact attention over a sequence sharded around the ring.
 
     ``q, k, v``: (S_local, d) per shard. K/V blocks rotate; each arrival
     updates the online-softmax state (running max m, normalizer l,
-    accumulator o) so the result is exactly ``softmax(QKᵀ/√d)·V`` over the
-    FULL sequence, never materialising more than one (S_local, S_local)
-    score block per chip.
+    accumulator o) so the result is exactly ``softmax(QKᵀ/√d)·V`` over
+    the FULL sequence.
+
+    ``kv_chunk`` bounds the materialised score tile: the resident K/V
+    block is processed in flash-attention-style chunks of that many keys
+    (a ``lax.scan`` applying the same online-softmax update), so peak
+    memory is O(S_local · kv_chunk) instead of O(S_local²) — at
+    S_local = 32k a full score block is 4 GB and out of HBM, while
+    kv_chunk = 1024 keeps it at 128 MB. ``None`` processes whole blocks
+    (fine for short sequences; fewer, larger MXU calls).
     """
     n = lax.axis_size(axis_name)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
 
-    def body(i, carry):
-        kb, vb, o, m, l = carry
-        scores = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * s
+    def online_update(o, m, l, kc, vc):
+        scores = jnp.dot(q, kc.T, preferred_element_type=jnp.float32) * s
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         # rescale previous accumulator to the new max
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new[:, None])
         l = l * alpha + jnp.sum(p, axis=-1)
         o = o * alpha[:, None] + jnp.dot(
-            p.astype(vb.dtype), vb, preferred_element_type=jnp.float32
+            p.astype(vc.dtype), vc, preferred_element_type=jnp.float32
         )
+        return o, m_new, l
+
+    s_local = k.shape[0]
+    if kv_chunk is not None and (
+        kv_chunk < 1 or (kv_chunk < s_local and s_local % kv_chunk)
+    ):
+        # kv_chunk >= s_local harmlessly degrades to whole-block
+        # processing (the tile bound is already satisfied)
+        raise ValueError(
+            f"kv_chunk={kv_chunk} must be >= 1 and divide the local "
+            f"K/V length {s_local}"
+        )
+
+    def process_block(kb, vb, o, m, l):
+        if kv_chunk is None or kv_chunk >= s_local:
+            return online_update(o, m, l, kb, vb)
+        n_chunks = s_local // kv_chunk
+
+        def chunk_step(carry, kv):
+            kc, vc = kv
+            return online_update(*carry, kc, vc), None
+
+        (o, m, l), _ = lax.scan(
+            chunk_step, (o, m, l),
+            (kb.reshape(n_chunks, kv_chunk, d),
+             vb.reshape(n_chunks, kv_chunk, d)),
+        )
+        return o, m, l
+
+    def body(i, carry):
+        kb, vb, o, m, l = carry
+        o, m, l = process_block(kb, vb, o, m, l)
         kb = lax.ppermute(kb, axis_name, _ring_perm(n))
         vb = lax.ppermute(vb, axis_name, _ring_perm(n))
-        return kb, vb, o, m_new, l
+        return kb, vb, o, m, l
 
     o0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
     m0 = jnp.full((q.shape[0],), -jnp.inf, dtype=jnp.float32)
