@@ -6,7 +6,6 @@ assert convergence into the same quality band rather than bit equality.
 
 import dataclasses
 
-import jax
 import numpy as np
 import pytest
 

@@ -2,7 +2,6 @@
 single-device dense computation."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 

@@ -1,6 +1,5 @@
 """Tests for checkpointing, metrics/EWMA, plotting, and the CLI surface."""
 
-import json
 import subprocess
 import sys
 

@@ -16,7 +16,7 @@ import numpy as np
 from tpu_distalg.models import ssgd
 from tpu_distalg.ops import logistic
 from tpu_distalg.ops import pallas_kernels as pk
-from tpu_distalg.utils import datasets, prng
+from tpu_distalg.utils import prng
 
 
 def test_fused_v3_convergence(tpu_mesh, cancer_data):

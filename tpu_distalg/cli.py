@@ -14,7 +14,6 @@ Run ``--emulate N`` to execute on N virtual CPU devices (Spark
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 

@@ -19,7 +19,6 @@ same patterns are XLA collectives riding the ICI links, invoked from inside
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from tpu_distalg.parallel.mesh import DATA_AXIS
