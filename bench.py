@@ -77,6 +77,7 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
     X, y = datasets.synthetic_two_class(N_ROWS, N_FEATURES, seed=0)
     X = datasets.add_bias_column(X)
     d = X.shape[1]
+    n_shards = int(mesh.shape["data"])
 
     if on_tpu:
         config = ssgd.SSGDConfig(
@@ -90,7 +91,6 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         ev = (jnp.zeros((1, meta["d_total"]), jnp.float32),
               jnp.zeros((1,), jnp.float32))
         args = (X2, dummy, dummy, ev[0], ev[1])
-        n_shards = int(mesh.shape["data"])
         _, n_sampled_local = ssgd.fused_gather_geometry(
             config, meta, n_shards)
         bytes_per_step = (n_sampled_local * n_shards * GATHER_BLOCK_ROWS
@@ -158,8 +158,12 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         "n_features": N_FEATURES,
         "steps_per_segment": N_STEPS,
         "bytes_per_step": bytes_per_step,
+        # per-chip fraction: per-chip bytes (global bytes_per_step /
+        # n_shards) × the TOTAL step rate — correct on (data, model>1)
+        # meshes too, where n_chips != n_shards
         "hbm_peak_fraction": round(
-            bytes_per_step * per_chip / V5E_HBM_BYTES_PER_SEC, 4),
+            bytes_per_step * best
+            / (n_shards * V5E_HBM_BYTES_PER_SEC), 4),
         "baseline_steps_per_sec_measured": round(measured_baseline, 2),
         "baseline_method": (
             "jit-per-step host-roundtrip loop (measured); "

@@ -119,6 +119,15 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh) -> DeviceEdges:
 
 
 def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
+    """Build the jitted n-iteration sweep.
+
+    PRECONDITION: the edge arrays passed to the returned ``run`` MUST be
+    dst-sorted per shard with order-preserving padding — exactly what
+    :func:`prepare_device_edges` produces. The segment-sums inside promise
+    ``indices_are_sorted=True`` to XLA, which is unchecked: unsorted
+    ``dst`` yields silently wrong rank sums, not an error. Construct the
+    inputs via :func:`prepare_device_edges` (or :func:`run`, which does).
+    """
     V = n_vertices
     q = config.q
 

@@ -236,6 +236,14 @@ def fused_gather_geometry(config: SSGDConfig, meta: dict, n_shards: int):
     """Per-shard block-sampling geometry of the 'fused_gather' sampler:
     (blocks per shard, blocks sampled per shard per step). Single source
     of truth — bench.py derives its bytes-per-step claim from this."""
+    if config.gather_block_rows % meta["pack"]:
+        # the kernel raises the same constraint at trace time; catching it
+        # here keeps the derived n_blocks/n_sampled (and bench.py's
+        # bytes-per-step claim) from silently using a truncated block size
+        raise ValueError(
+            f"gather_block_rows={config.gather_block_rows} must be a "
+            f"multiple of pack={meta['pack']}"
+        )
     bp = config.gather_block_rows // meta["pack"]
     n2_local = (meta["n_padded"] // meta["pack"]) // n_shards
     n_blocks = n2_local // bp
@@ -358,7 +366,13 @@ def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Fixed-size per-shard gather sampling: each shard draws exactly
     ``frac·n_local`` local row indices per step and gathers only those rows
     — the HBM-traffic-optimal sampler (the Bernoulli mask touches every
-    row of X every step). Gathered padding rows carry zero mask weight."""
+    row of X every step). Gathered padding rows carry zero mask weight.
+
+    The draw is WITHOUT replacement (a per-step permutation slice),
+    matching ``sample(False, ...)``'s contract (``ssgd.py:97``) — no row
+    can count twice in (Σg, cnt). The permutation is O(n_local log
+    n_local) per step, which is immaterial here: this sampler's gather
+    path is already the measured-slower, non-default option."""
     from jax import lax
 
     from tpu_distalg.parallel import DATA_AXIS
@@ -377,7 +391,7 @@ def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     def _local_grad(X, y, valid, w, t):
         shard = lax.axis_index(DATA_AXIS)
         k = jax.random.fold_in(jax.random.fold_in(key, t), shard)
-        idx = jax.random.randint(k, (b_local,), 0, X.shape[0])
+        idx = jax.random.permutation(k, X.shape[0])[:b_local]
         g, cnt = logistic.grad_sum(X[idx], y[idx], w, valid[idx])
         return tree_allreduce_sum((g, cnt))
 

@@ -179,8 +179,10 @@ def run_sparse(edges: np.ndarray, mesh: Mesh,
             return jnp.sum((x < V).astype(jnp.int32))
 
         def cond(state):
-            _, _, old_cnt, cnt, it, _ = state
-            return (cnt != old_cnt) & (it < cap)
+            _, _, old_cnt, cnt, it, overflow = state
+            # ~overflow: fail fast — once a round overflows its buffers the
+            # result can never be trusted, so don't pay the remaining rounds
+            return (cnt != old_cnt) & (it < cap) & ~overflow
 
         def body(state):
             px, pz, _, cnt, it, overflow = state
@@ -189,7 +191,13 @@ def run_sparse(edges: np.ndarray, mesh: Mesh,
             k = deg[pz]                              # (C,)
             start = jnp.cumsum(k) - k                # exclusive prefix
             K = start[-1] + k[-1]                    # true join size
-            overflow = overflow | (K > J)
+            # K is int32 and can wrap negative (or to a small positive) when
+            # the true join exceeds 2^31. The exact K > J test catches every
+            # non-wrapping overflow; the f32 sum (24-bit mantissa — NOT
+            # exact, only a coarse threshold) and the sign test catch the
+            # wrapped cases the exact test misses.
+            Kf = jnp.sum(k.astype(jnp.float32))
+            overflow = overflow | (K > J) | (Kf > J) | (K < 0)
             # mark slot start_p with p+1 (k>0 paths only), cummax fills
             # the segment; -1 → owning path id
             marks = jnp.zeros((J,), jnp.int32).at[

@@ -118,7 +118,10 @@ def run_segmented(
             saved_tag = np.asarray(
                 payload["tag"]).tobytes().decode(errors="replace")
         else:
-            saved_tag = tag  # pre-tag checkpoint format: shapes decide
+            # legacy pre-tag payloads ({'w','accs'}) also lack 'state', so
+            # the check below always rejects them: old checkpoints need a
+            # fresh directory, not a silent cross-format resume
+            saved_tag = tag
         sig = [(tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
                for v in payload.get("state", [])]
         want = [(tuple(np.asarray(x).shape), str(np.asarray(x).dtype))
