@@ -1,7 +1,11 @@
 """End-to-end optimizer tests against the reference's golden accuracies
 (BASELINE.md): LR 0.9415, SSGD 0.9298, MA 0.8538, BMUF 0.9298, EASGD 0.9298
-on breast-cancer 70/30. Our runs use different (seeded) inits and f32, so we
-assert convergence into the same quality band rather than bit equality.
+on breast-cancer 70/30. Our runs use different (seeded) inits, so our
+deterministic results differ from the reference goldens (they land at or
+above them); with seeds pinned each run IS deterministic, so every test
+asserts its own measured value two-sided with atol=0.01 (~2 flipped test
+samples of 171) of platform-drift headroom — a deliberate change in
+convergence behavior, better OR worse, must update the pinned value here.
 """
 
 import dataclasses
@@ -18,11 +22,11 @@ def test_ssgd_converges(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500),
     )
-    # measured deterministic result 0.9415 (pinned seeds) — above the
-    # reference golden 0.9298; the floor leaves ~2pts (≈4 flipped test
-    # samples of 171) for platform numeric drift while still failing a
-    # 4-point regression
-    assert res.final_acc >= 0.92, res.final_acc
+    # seeds are pinned, so the run is deterministic: assert the measured
+    # value itself (0.9415, above the reference golden 0.9298) with 1pt
+    # of tolerance (~2 flipped test samples of 171) for platform numeric
+    # drift — a 1.5-point regression now fails
+    np.testing.assert_allclose(res.final_acc, 0.9415, atol=0.01)
     assert res.accs.shape == (1500,)
 
 
@@ -32,7 +36,7 @@ def test_ssgd_with_l2(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500, lam=1e-4, reg_type="l2"),
     )
-    assert res.final_acc >= 0.92  # measured 0.9415 deterministic
+    np.testing.assert_allclose(res.final_acc, 0.9415, atol=0.01)
 
 
 def test_full_batch_lr_converges(mesh8, cancer_data):
@@ -42,7 +46,7 @@ def test_full_batch_lr_converges(mesh8, cancer_data):
         logistic_regression.LRConfig(n_iterations=1500),
     )
     # measured 0.9415 = the reference golden exactly (logistic_regression.py:109)
-    assert res.final_acc >= 0.92, res.final_acc
+    np.testing.assert_allclose(res.final_acc, 0.9415, atol=0.01)
 
 
 def test_ma_converges(mesh4, cancer_data):
@@ -54,7 +58,7 @@ def test_ma_converges(mesh4, cancer_data):
         ma.MAConfig(n_iterations=300),
     )
     # measured 0.9298 deterministic — well above the golden 0.8538
-    assert res.final_acc >= 0.90, res.final_acc
+    np.testing.assert_allclose(res.final_acc, 0.9298, atol=0.01)
 
 
 def test_bmuf_converges(mesh4, cancer_data):
@@ -63,7 +67,8 @@ def test_bmuf_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         bmuf.BMUFConfig(n_iterations=300),
     )
-    assert res.final_acc >= 0.92, res.final_acc  # measured 0.9415; golden 0.9298
+    # measured 0.9415 deterministic; reference golden 0.9298
+    np.testing.assert_allclose(res.final_acc, 0.9415, atol=0.01)
 
 
 def test_easgd_converges(mesh4, cancer_data):
@@ -72,7 +77,8 @@ def test_easgd_converges(mesh4, cancer_data):
         X_train, y_train, X_test, y_test, mesh4,
         easgd.EASGDConfig(n_iterations=1500),
     )
-    assert res.final_acc >= 0.91, res.final_acc  # measured 0.9298 = golden
+    # measured 0.9298 deterministic = the reference golden exactly
+    np.testing.assert_allclose(res.final_acc, 0.9298, atol=0.01)
 
 
 def test_ssgd_topology_independence(mesh1, mesh8, cancer_data):
@@ -105,7 +111,8 @@ def test_ssgd_fixed_sampler(mesh8, cancer_data):
         X_train, y_train, X_test, y_test, mesh8,
         ssgd.SSGDConfig(n_iterations=1500, sampler="fixed"),
     )
-    assert res.final_acc >= 0.89, res.final_acc  # measured 0.9006 deterministic
+    # measured 0.9181 deterministic (without-replacement permutation draw)
+    np.testing.assert_allclose(res.final_acc, 0.9181, atol=0.01)
 
 
 def test_ssgd_fused_gather_sampler(mesh4, cancer_data):

@@ -191,13 +191,15 @@ def run_sparse(edges: np.ndarray, mesh: Mesh,
             k = deg[pz]                              # (C,)
             start = jnp.cumsum(k) - k                # exclusive prefix
             K = start[-1] + k[-1]                    # true join size
-            # K is int32 and can wrap negative (or to a small positive) when
-            # the true join exceeds 2^31. The exact K > J test catches every
-            # non-wrapping overflow; the f32 sum (24-bit mantissa — NOT
-            # exact, only a coarse threshold) and the sign test catch the
-            # wrapped cases the exact test misses.
+            # K is int32 and can wrap when the true join exceeds 2^31. The
+            # exact K > J test catches every non-wrapping overflow; K < 0
+            # catches true sizes in (2^31, 2^32); the f32 sum catches
+            # >= 2^32 wrap-to-positive. Kf is compared against 2^31 (not J)
+            # because the tree-reduction rounding of the f32 sum could
+            # otherwise spuriously trip on a valid round with K ~ J.
             Kf = jnp.sum(k.astype(jnp.float32))
-            overflow = overflow | (K > J) | (Kf > J) | (K < 0)
+            overflow = (overflow | (K > J) | (K < 0)
+                        | (Kf > jnp.float32(2**31)))
             # mark slot start_p with p+1 (k>0 paths only), cummax fills
             # the segment; -1 → owning path id
             marks = jnp.zeros((J,), jnp.int32).at[
