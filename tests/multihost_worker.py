@@ -1,0 +1,91 @@
+"""Multi-host worker — run once per process by tests/test_multihost.py.
+
+Exercises the DCN half of the comm backend (SURVEY.md §2.4) the way the
+reference gets it from Spark for free (same script runs on a cluster,
+``/root/reference/optimization/ssgd.py:78-81``): two OS processes, each
+owning 4 virtual CPU devices, join one ``jax.distributed`` runtime and run
+the SAME program over the 8-device global mesh — cross-process psum,
+process-addressable-only shard construction, and a real workload.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <coord>
+Prints ``MULTIHOST_OK <pid>`` on success (the parent test asserts it).
+"""
+
+import os
+import sys
+
+pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+# REPLACE (not append): the parent pytest env carries the 8-device flag
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tpu_distalg.parallel import (  # noqa: E402
+    DATA_AXIS,
+    build_sharded,
+    data_parallel,
+    get_mesh,
+    multihost_initialize,
+    tree_allreduce_sum,
+)
+
+multihost_initialize(
+    coordinator_address=coord, num_processes=nproc, process_id=pid
+)
+# idempotence: a second call must be a no-op, not a crash
+multihost_initialize(
+    coordinator_address=coord, num_processes=nproc, process_id=pid
+)
+
+assert jax.process_count() == nproc, jax.process_count()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 4 * nproc
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+mesh = get_mesh()  # all 8 global devices on the data axis
+assert mesh.shape[DATA_AXIS] == 4 * nproc
+
+# build_sharded constructs each shard ON the device that owns it — this
+# process must end up holding exactly its 4 addressable shards, and no
+# host ever materializes rows owned by the other process
+N_ROWS = 16
+sm = build_sharded(mesh, N_ROWS, lambda ids: (ids + 1).astype(jnp.float32))
+shards = sm.data.addressable_shards
+assert len(shards) == 4, len(shards)
+for sh in shards:
+    assert sh.device.process_index == pid, (sh.device, pid)
+
+# a psum that MUST cross the process boundary: every shard contributes
+# its local masked sum; the global total covers rows owned by both
+# processes (sum 1..16 = 136)
+def _local(x, m):
+    return tree_allreduce_sum(jnp.sum(x * m))
+
+
+total = jax.jit(data_parallel(
+    _local, mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P()
+))(sm.data, sm.mask)
+got = float(total.addressable_data(0))
+assert got == N_ROWS * (N_ROWS + 1) / 2, got
+
+# per-shard identity crosses too: gather every shard's axis_index via
+# psum of one-hots — proves all 8 mesh positions are live, not 4 mirrored
+def _onehot():
+    s = lax.axis_index(DATA_AXIS)
+    return lax.psum(
+        (jnp.arange(4 * nproc) == s).astype(jnp.int32), DATA_AXIS
+    )
+
+
+ones = jax.jit(data_parallel(_onehot, mesh, in_specs=(), out_specs=P()))()
+np.testing.assert_array_equal(
+    np.asarray(ones.addressable_data(0)), np.ones(4 * nproc, np.int32)
+)
+
+print(f"MULTIHOST_OK {pid}", flush=True)

@@ -69,6 +69,17 @@ def main(argv=None):
                              "(jax.distributed over DCN) before building "
                              "the mesh; run the same command on every "
                              "host of the slice group")
+    parser.add_argument("--coordinator-address", type=str, default=None,
+                        help="host:port of process 0's coordinator "
+                             "(with --multihost); omit on TPU pods and "
+                             "managed clusters, where jax.distributed "
+                             "auto-detects the topology")
+    parser.add_argument("--num-processes", type=int, default=None,
+                        help="total process count (with "
+                             "--coordinator-address)")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="this process's rank (with "
+                             "--coordinator-address)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("lr", help="full-batch logistic regression")
@@ -150,7 +161,21 @@ def main(argv=None):
     if args.multihost:
         from tpu_distalg.parallel.mesh import multihost_initialize
 
-        multihost_initialize()
+        if (args.coordinator_address is None
+                and (args.num_processes is not None
+                     or args.process_id is not None)):
+            parser.error(
+                "--num-processes/--process-id require "
+                "--coordinator-address (omit all three to auto-detect)"
+            )
+        kwargs = {
+            k: v for k, v in (
+                ("coordinator_address", args.coordinator_address),
+                ("num_processes", args.num_processes),
+                ("process_id", args.process_id),
+            ) if v is not None
+        }
+        multihost_initialize(**kwargs)
 
     import jax  # after emulation setup
 

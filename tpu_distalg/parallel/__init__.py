@@ -13,6 +13,7 @@ from tpu_distalg.parallel.mesh import (
     MeshContext,
     get_mesh,
     local_device_count,
+    multihost_initialize,
 )
 from tpu_distalg.parallel.sharding import (
     ShardedMatrix,
@@ -44,6 +45,7 @@ __all__ = [
     "data_sharding",
     "get_mesh",
     "local_device_count",
+    "multihost_initialize",
     "pad_rows",
     "parallelize",
     "replica_index",

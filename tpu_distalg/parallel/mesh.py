@@ -100,24 +100,33 @@ def get_mesh(
     need = data * model
     if need > n:
         raise ValueError(f"mesh {data}x{model} needs {need} devices, have {n}")
-    if (devices is None and need == n and n > 1
+    grid = _topology_grid(devs, data, model, explicit=devices is not None)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def _topology_grid(devs, data: int, model: int, *, explicit: bool):
+    """Arrange ``devs`` into the ``(data, model)`` grid per the topology
+    policy in :func:`get_mesh`'s docstring. Pure device-list → grid
+    function so the DCN-hybrid / ICI-torus / fallback branches are unit-
+    testable with fake device objects (no TPU hardware required)."""
+    need = data * model
+    if (not explicit and need == len(devs) and len(devs) > 1
             and devs[0].platform == "tpu"):
         from jax.experimental import mesh_utils
 
         n_slices = len({getattr(d, "slice_index", 0) for d in devs})
         try:
             if n_slices > 1 and data % n_slices == 0:
-                grid = mesh_utils.create_hybrid_device_mesh(
-                    (data // n_slices, model), (n_slices, 1)
+                return mesh_utils.create_hybrid_device_mesh(
+                    (data // n_slices, model), (n_slices, 1), devices=devs
                 )
-                return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
             if n_slices == 1:
-                grid = mesh_utils.create_device_mesh((data, model))
-                return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+                return mesh_utils.create_device_mesh(
+                    (data, model), devices=devs
+                )
         except (NotImplementedError, ValueError):
             pass  # topology can't express the shape: row-major fallback
-    grid = np.array(devs[:need]).reshape(data, model)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    return np.array(devs[:need]).reshape(data, model)
 
 
 @dataclasses.dataclass(frozen=True)
