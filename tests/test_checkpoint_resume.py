@@ -148,6 +148,27 @@ def test_fused_gather_segmented_equals_straight(mesh4, data, tmp_path):
                                   np.asarray(seg.accs))
 
 
+def test_local_sgd_fused_segmented_equals_straight(mesh4, data, tmp_path):
+    """The fused local-update path checkpoints bitwise too: the
+    augmented (w, ws, delta) carry and absolute-round block draws make
+    segmented ≡ straight for the packed kernel family."""
+    from tpu_distalg.models import bmuf
+
+    X_train, y_train, X_test, y_test = data
+    cfg = bmuf.BMUFConfig(n_iterations=60, sampler="fused_gather",
+                          fused_pack=4, gather_block_rows=32,
+                          shuffle_seed=0)
+    straight = bmuf.train(X_train, y_train, X_test, y_test, mesh4, cfg)
+    seg = bmuf.train(X_train, y_train, X_test, y_test, mesh4, cfg,
+                     checkpoint_dir=str(tmp_path / "lsf"),
+                     checkpoint_every=25)
+    np.testing.assert_array_equal(np.asarray(straight.w), np.asarray(seg.w))
+    np.testing.assert_array_equal(np.asarray(straight.ws),
+                                  np.asarray(seg.ws))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(seg.accs))
+
+
 # ---- ALS ----
 
 def test_als_segmented_equals_straight(mesh8, tmp_path):

@@ -92,15 +92,17 @@ def test_cli_multihost_monte_carlo():
         "--num-processes", "2", "--process-id", str(pid),
         "mc", "--n", "400000",
     ])
+    pi_lines = []
     for out in outs:
         line = [ln for ln in out.splitlines()
                 if ln.startswith("Pi is roughly")]
         assert line, out[-4000:]
         pi = float(line[0].split()[-1])
         assert 3.10 < pi < 3.18, pi
+        pi_lines.append(line[0])
     # both processes computed the SAME global estimate (one psum over all
     # 8 shards), not two disjoint 4-shard estimates
-    assert outs[0].splitlines()[-1] == outs[1].splitlines()[-1]
+    assert pi_lines[0] == pi_lines[1]
 
 
 class _FakeTpuDevice:

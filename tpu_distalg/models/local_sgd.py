@@ -59,6 +59,17 @@ class LocalSGDConfig:
     seed: int = 42
     init_seed: int = 7
     eval_test: bool = True
+    # TPU perf knobs (not in the reference) — the flagship SSGD treatment
+    # applied to the local-update family. 'bernoulli' = XLA mask over all
+    # rows (reference sample() semantics); 'fused_gather' = the packed
+    # traffic-proportional Pallas kernel: each replica's local step DMAs
+    # only its sampled gather_block_rows-row blocks (same grad_sum
+    # contract, block-cluster sampling — see ssgd.SSGDConfig.sampler).
+    sampler: str = "bernoulli"
+    x_dtype: str = "float32"
+    fused_pack: int = 16
+    gather_block_rows: int = 1024
+    shuffle_seed: int | None = None
 
 
 @dataclasses.dataclass
@@ -98,13 +109,33 @@ def _make_local_rounds(config: LocalSGDConfig):
     return local_rounds
 
 
+def _derive_beta(config: LocalSGDConfig, n_replicas: int) -> float:
+    return (config.beta if config.beta is not None
+            else n_replicas * config.elastic_alpha)  # easgd.py:25
+
+
+def _make_combine(config: LocalSGDConfig, beta: float):
+    """Round-level combine shared by the XLA and fused builders — the
+    ONE place the MA/BMUF/EASGD center updates live, so the two sampler
+    paths cannot drift apart. Returns ``(w, delta) = combine(w, w_avg,
+    delta)``."""
+
+    def combine(w, w_avg, delta):
+        if config.global_update == "average":
+            return w_avg, delta
+        if config.global_update == "bmuf":
+            delta = config.mu * delta + config.zeta * (w_avg - w)
+            return w + delta, delta  # bmuf.py:113-114
+        if config.global_update == "easgd":
+            return (1 - beta) * w + beta * w_avg, delta  # easgd.py:106
+        raise ValueError(config.global_update)
+
+    return combine
+
+
 def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
     n_replicas = mesh.shape[DATA_AXIS]
-    beta = (
-        config.beta
-        if config.beta is not None
-        else n_replicas * config.elastic_alpha  # easgd.py:25
-    )
+    beta = _derive_beta(config, n_replicas)
     L = config.n_local_iterations
     key = prng.root_key(config.seed)
 
@@ -138,20 +169,14 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
         )
         return jnp.broadcast_to(mask, (L, n_padded))
 
+    combine = _make_combine(config, beta)
+
     def train(X, y, valid, X_test, y_test, w0, ws0, delta0, t0=0):
         def round_step(carry, t):
             w, ws, delta = carry
             masks = round_masks(valid, t)
             ws, w_avg = local_fn(X, y, masks, ws, w)
-            if config.global_update == "average":
-                w = w_avg
-            elif config.global_update == "bmuf":
-                delta = config.mu * delta + config.zeta * (w_avg - w)
-                w = w + delta  # bmuf.py:113-114
-            elif config.global_update == "easgd":
-                w = (1 - beta) * w + beta * w_avg  # easgd.py:106
-            else:
-                raise ValueError(config.global_update)
+            w, delta = combine(w, w_avg, delta)
             acc = (
                 metrics.binary_accuracy(X_test @ w, y_test)
                 if config.eval_test
@@ -170,6 +195,216 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
     return jax.jit(train)
 
 
+def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
+    """Fused-kernel local rounds: every replica's local step runs the
+    traffic-proportional gathered Pallas kernel on ITS OWN packed shard
+    (``pallas_kernels.fused_grad_sum_gathered`` — the same one-HBM-pass
+    kernel as SSGD's flagship sampler; the local step's ``grad_sum``
+    contract is identical, only the combine differs). Local steps touch
+    no interconnect; the round-end pmean is the only collective —
+    exactly the reference's job-per-round boundary (``ma.py:98-106``).
+
+    All (round, local-step, shard) block draws happen in one batched
+    threefry before the scan; the id array is sharded over the data axis
+    so each replica carries only its own draw column.
+    """
+    import functools
+
+    from tpu_distalg.models.ssgd import fused_gather_geometry
+    from tpu_distalg.ops import pallas_kernels
+
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    d_t = meta["d_total"]
+    col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(jnp.float32)
+    n_shards = mesh.shape[DATA_AXIS]
+    n_blocks, n_sampled = fused_gather_geometry(config, meta, n_shards)
+    L = config.n_local_iterations
+    beta = _derive_beta(config, n_replicas=n_shards)
+    key = prng.root_key(config.seed)
+    kern = functools.partial(
+        pallas_kernels.fused_grad_sum_gathered,
+        pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+        v_col=meta["v_col"],
+        gather_block_rows=config.gather_block_rows,
+        interpret=not on_tpu,
+    )
+
+    def prep_idx(ts):
+        """(T, L, S, ns) sampled block ids; without resampling the one
+        per-round draw is broadcast over L (reference parity: the same
+        minibatch serves every local step of a round, ``ma.py:98-99``)."""
+        n_draws = L if config.resample_per_local_step else 1
+
+        def draw_round(t):
+            def draw_one(l):
+                ks = jax.vmap(lambda s: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(key, t), l), s
+                ))(jnp.arange(n_shards))
+                bits = jax.vmap(
+                    lambda k: jax.random.bits(k, (n_blocks,))
+                )(ks)
+                return jnp.argsort(bits, axis=-1)[:, :n_sampled]
+
+            return jax.vmap(draw_one)(jnp.arange(n_draws))
+
+        idx = jax.vmap(draw_round)(ts).astype(jnp.int32)
+        return jnp.broadcast_to(
+            idx, (ts.shape[0], L, n_shards, n_sampled))
+
+    def local_rounds(X2, idx_round, ws_local, w):
+        # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's draws
+        w_l = w if config.resync else ws_local[0]
+
+        def local_step(w_l, idx_l):
+            g, cnt = kern(X2, w_l, idx_l[0])
+            g_mean = (g * col_keep) / jnp.maximum(cnt, 1.0)
+            w_l = (
+                w_l
+                - config.eta * g_mean
+                - config.elastic_alpha * (w_l - w)  # easgd.py:41-45
+            )
+            return w_l, None
+
+        w_l, _ = jax.lax.scan(local_step, w_l, idx_round)
+        return w_l[None, :], tree_allreduce_mean(w_l)
+
+    local_fn = data_parallel(
+        local_rounds, mesh,
+        in_specs=(
+            P("data", None),          # packed rows
+            P(None, "data", None),    # (L, S, ns) draws → (L, 1, ns)
+            P("data", None),          # per-replica models
+            P(),                      # center w
+        ),
+        out_specs=(P("data", None), P()),
+    )
+
+    combine = _make_combine(config, beta)
+
+    def train(X2, X_test, y_test, w0, ws0, delta0, t0=0):
+        ts = jnp.arange(config.n_iterations) + t0
+        idx_all = prep_idx(ts)                    # (T, L, S, ns)
+
+        def round_step(carry, idx_round):
+            w, ws, delta = carry
+            ws, w_avg = local_fn(X2, idx_round, ws, w)
+            w, delta = combine(w, w_avg, delta)
+            acc = (
+                metrics.binary_accuracy(X_test @ w, y_test)
+                if config.eval_test
+                else jnp.float32(0)
+            )
+            return (w, ws, delta), acc
+
+        (w, ws, delta), accs = jax.lax.scan(
+            round_step, (w0, ws0, delta0), idx_all
+        )
+        return w, ws, delta, accs
+
+    return jax.jit(train)
+
+
+def prepare_fused(X_train, y_train, mesh: Mesh, config: LocalSGDConfig):
+    """One-time setup for the fused sampler (mirrors
+    ``ssgd.prepare_fused``): pack (X, y, validity) into the kernel
+    layout, shard over the data axis, build augmented initial state and
+    the jitted round scan. Returns ``(fn, X2, w0, ws0, delta0, meta)``;
+    call as ``fn(X2, X_test_padded, y_test, w0, ws0, delta0)``."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.ops import pallas_kernels
+
+    n_shards = mesh.shape[DATA_AXIS]
+    D = X_train.shape[1]
+    n = X_train.shape[0]
+    X2, meta = pallas_kernels.pack_augmented(
+        np.asarray(X_train), np.asarray(y_train), np.ones(n, np.float32),
+        dtype=jnp.dtype(config.x_dtype),
+        pack=config.fused_pack,
+        block_rows=config.gather_block_rows * n_shards,
+        shuffle_seed=config.shuffle_seed,
+    )
+    X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
+    d_t = meta["d_total"]
+    n_replicas = n_shards
+    k_init = prng.root_key(config.init_seed)
+    w0 = jnp.zeros((d_t,), jnp.float32).at[:D].set(
+        logistic.init_weights(jax.random.fold_in(k_init, 0), D)
+    )
+    # per-replica init ~ U[-1,1) in the true columns (ma.py:86); the
+    # y/v/pad columns stay zero forever (zeroed grad, zero elastic pull)
+    ws0 = jnp.zeros((n_replicas, d_t), jnp.float32).at[:, :D].set(
+        jax.random.uniform(
+            jax.random.fold_in(k_init, 1), (n_replicas, D),
+            minval=-1.0, maxval=1.0,
+        )
+    )
+    if config.global_update == "bmuf" and config.random_delta_init:
+        delta0 = jnp.zeros((d_t,), jnp.float32).at[:D].set(
+            jax.random.uniform(
+                jax.random.fold_in(k_init, 2), (D,),
+                minval=-1.0, maxval=1.0,
+            )
+        )
+    else:
+        delta0 = jnp.zeros((d_t,))
+    fn = make_train_fn_fused(mesh, config, meta)
+    return fn, X2, w0, ws0, delta0, meta
+
+
+def _train_fused(
+    X_train, y_train, X_test, y_test, mesh: Mesh,
+    config: LocalSGDConfig,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 100,
+) -> TrainResult:
+    import numpy as np
+
+    D = X_train.shape[1]
+    fn, X2, w0, ws0, delta0, meta = prepare_fused(
+        X_train, y_train, mesh, config)
+    X_te = jnp.asarray(
+        np.pad(np.asarray(X_test, np.float32),
+               ((0, 0), (0, meta["d_total"] - D)))
+    )
+    y_te = jnp.asarray(y_test)
+
+    if checkpoint_dir is None:
+        w, ws, _, accs = fn(X2, X_te, y_te, w0, ws0, delta0)
+        metrics.guard_finite((w, ws), "local-SGD (fused) models")
+        return TrainResult(w=w[:D], ws=ws[:, :D], accs=accs)
+
+    from jax.sharding import NamedSharding
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    ws_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def run_seg(seg_fn, state, t0):
+        w, ws, delta = state
+        ws = jax.device_put(jnp.asarray(ws), ws_sharding)
+        w, ws, delta, accs = seg_fn(
+            X2, X_te, y_te, jnp.asarray(w), ws, jnp.asarray(delta),
+            t0=t0,
+        )
+        return (w, ws, delta), accs
+
+    (w, ws, delta), accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn_fused(
+            mesh, dataclasses.replace(config, n_iterations=seg), meta),
+        run_seg=run_seg,
+        state0=(w0, ws0, delta0),
+        tag=f"local_sgd:{config.global_update}:{config.sampler}",
+    )
+    return TrainResult(
+        w=jnp.asarray(w)[:D], ws=jnp.asarray(ws)[:, :D],
+        accs=jnp.asarray(accs),
+    )
+
+
 def train(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: LocalSGDConfig = LocalSGDConfig(),
@@ -186,7 +421,15 @@ def train(
     runs are bitwise-identical because round PRNG keys use absolute
     round ids.
     """
-    Xs = parallelize(X_train, mesh)
+    if config.sampler == "fused_gather":
+        return _train_fused(
+            X_train, y_train, X_test, y_test, mesh, config,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
+    if config.sampler != "bernoulli":
+        raise ValueError(f"unknown sampler {config.sampler!r}")
+    Xs = parallelize(X_train, mesh, dtype=jnp.dtype(config.x_dtype))
     ys = parallelize(y_train, mesh)
     D = X_train.shape[1]
     n_replicas = mesh.shape[DATA_AXIS]

@@ -36,7 +36,8 @@ def trace(logdir: str):
 
 
 def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
-                  warmup: bool = True, with_output: bool = False):
+                  warmup: bool = True, with_output: bool = False,
+                  with_stats: bool = False):
     """Best-of-``repeats`` throughput of ``fn(*args)``, where one call runs
     ``steps`` device-side steps (e.g. a scan segment) as ONE compiled
     program. Completion is observed by fetching the program's first
@@ -48,9 +49,12 @@ def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
     completion of all of them). Huge leaves fetch a single element
     instead (stays addressable on multi-host meshes).
 
-    ``with_output=True`` returns ``(steps_per_sec, last_output)`` so a
-    caller that also wants the computed result (e.g. trained weights for
-    a convergence check) need not re-run the program."""
+    ``with_output=True`` appends the last output (e.g. trained weights
+    for a convergence check — no re-run needed). ``with_stats=True``
+    appends a ``{"repeats", "best", "median", "min"}`` dict of the
+    per-repeat rates: on shared chips run-to-run throughput varies
+    (±40% observed), so a single best-of number is not comparable
+    across sessions without the spread next to it."""
     import numpy as np
 
     def fetch():
@@ -65,10 +69,20 @@ def steps_per_sec(fn, *args, steps: int, repeats: int = 3,
         return out
 
     out = fetch() if warmup else None
-    best = float("inf")
+    rates = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fetch()
-        best = min(best, time.perf_counter() - t0)
-    rate = steps / best
-    return (rate, out) if with_output else rate
+        rates.append(steps / (time.perf_counter() - t0))
+    stats = {
+        "repeats": repeats,
+        "best": round(max(rates), 2),
+        "median": round(float(np.median(rates)), 2),
+        "min": round(min(rates), 2),
+    }
+    result = (max(rates),)
+    if with_stats:
+        result += (stats,)
+    if with_output:
+        result += (out,)
+    return result[0] if len(result) == 1 else result
