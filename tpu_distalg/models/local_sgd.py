@@ -230,24 +230,25 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
     )
 
     def prep_idx(ts):
-        """(T, L, S, ns) sampled block ids; without resampling the one
-        per-round draw is broadcast over L (reference parity: the same
-        minibatch serves every local step of a round, ``ma.py:98-99``)."""
+        """(T, L, S, ns) sampled block ids via the shared
+        without-replacement draw (``sampling.sample_block_ids``), keyed
+        on (absolute round id, local-step index, shard); without
+        resampling the one per-round draw is broadcast over L (reference
+        parity: the same minibatch serves every local step of a round,
+        ``ma.py:98-99``)."""
+        from tpu_distalg.ops import sampling
+
         n_draws = L if config.resample_per_local_step else 1
 
         def draw_round(t):
-            def draw_one(l):
-                ks = jax.vmap(lambda s: jax.random.fold_in(
-                    jax.random.fold_in(jax.random.fold_in(key, t), l), s
-                ))(jnp.arange(n_shards))
-                bits = jax.vmap(
-                    lambda k: jax.random.bits(k, (n_blocks,))
-                )(ks)
-                return jnp.argsort(bits, axis=-1)[:, :n_sampled]
+            return jax.vmap(
+                lambda l: sampling.sample_block_ids(
+                    jax.random.fold_in(jax.random.fold_in(key, t), l),
+                    n_shards, n_blocks, n_sampled,
+                )
+            )(jnp.arange(n_draws))
 
-            return jax.vmap(draw_one)(jnp.arange(n_draws))
-
-        idx = jax.vmap(draw_round)(ts).astype(jnp.int32)
+        idx = jax.vmap(draw_round)(ts)
         return jnp.broadcast_to(
             idx, (ts.shape[0], L, n_shards, n_sampled))
 

@@ -254,6 +254,17 @@ def fused_gather_geometry(config: SSGDConfig, meta: dict, n_shards: int):
             f"with block_rows a multiple of gather_block_rows × n_shards"
         )
     n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+    eff = n_sampled / n_blocks
+    if abs(eff - config.mini_batch_fraction) > \
+            0.25 * config.mini_batch_fraction:
+        import warnings
+
+        warnings.warn(
+            f"fused_gather: {n_blocks} blocks/shard quantizes the "
+            f"minibatch fraction to {eff:.3f} (configured "
+            f"{config.mini_batch_fraction}); lower gather_block_rows "
+            f"or fused_pack for a finer grid", stacklevel=2,
+        )
     return n_blocks, n_sampled
 
 
@@ -283,19 +294,9 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     prep_xs = None
 
     if config.sampler == "fused_gather":
+        # geometry warns when n_blocks quantizes the fraction coarsely
         n_blocks, n_sampled = fused_gather_geometry(
             config, meta, n_shards)
-        eff = n_sampled / n_blocks
-        if abs(eff - config.mini_batch_fraction) > \
-                0.25 * config.mini_batch_fraction:
-            import warnings
-
-            warnings.warn(
-                f"fused_gather: {n_blocks} blocks/shard quantizes the "
-                f"minibatch fraction to {eff:.3f} (configured "
-                f"{config.mini_batch_fraction}); lower gather_block_rows "
-                f"or fused_pack for a finer grid", stacklevel=2,
-            )
         key = prng.root_key(config.seed)
         kern = functools.partial(
             pallas_kernels.fused_grad_sum_gathered,
@@ -306,21 +307,16 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
         )
 
         def prep_xs(ts):
-            # ALL (step, shard) block draws in one batched threefry +
-            # argsort — a without-replacement sample of n_sampled block
-            # ids per (t, shard), deterministic in the absolute step id
-            def draw(t):
-                ks = jax.vmap(
-                    lambda s: jax.random.fold_in(
-                        jax.random.fold_in(key, t), s
-                    )
-                )(jnp.arange(n_shards))
-                bits = jax.vmap(
-                    lambda k: jax.random.bits(k, (n_blocks,))
-                )(ks)
-                return jnp.argsort(bits, axis=-1)[:, :n_sampled]
-
-            return jax.vmap(draw)(ts).astype(jnp.int32)  # (T, S, ns)
+            # ALL (step, shard) block draws in one batched threefry —
+            # the shared without-replacement draw
+            # (sampling.sample_block_ids), per-round key = fold_in(key,
+            # absolute step id)
+            return jax.vmap(
+                lambda t: sampling.sample_block_ids(
+                    jax.random.fold_in(key, t),
+                    n_shards, n_blocks, n_sampled,
+                )
+            )(ts)                                        # (T, S, ns)
 
         def _local_grad(X2, w, idx_shards):
             shard = lax.axis_index(DATA_AXIS)

@@ -27,6 +27,26 @@ def bernoulli_mask(
     return jnp.where(u < fraction, 1.0, 0.0) * valid
 
 
+def sample_block_ids(
+    base_key: jax.Array, n_shards: int, n_blocks: int, n_sampled: int
+) -> jax.Array:
+    """Per-shard without-replacement block draw shared by the fused
+    gather samplers (SSGD's flagship path and the local-update family):
+    for each shard s, ``fold_in(base_key, s)`` seeds one threefry draw
+    and the ``n_sampled`` smallest of ``n_blocks`` random words are the
+    sampled block ids — a uniform without-replacement sample,
+    deterministic in ``base_key`` and independent of device topology.
+    Returns (n_shards, n_sampled) int32. Callers build ``base_key`` from
+    the absolute step id (and local-step index where applicable), so
+    segmented checkpoint/resume replays identical draws.
+    """
+    ks = jax.vmap(
+        lambda s: jax.random.fold_in(base_key, s)
+    )(jnp.arange(n_shards))
+    bits = jax.vmap(lambda k: jax.random.bits(k, (n_blocks,)))(ks)
+    return jnp.argsort(bits, axis=-1)[:, :n_sampled].astype(jnp.int32)
+
+
 def mc_circle_hits(key: jax.Array, n: int) -> jax.Array:
     """Count darts landing in the unit circle out of ``n`` thrown.
 
