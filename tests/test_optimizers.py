@@ -196,12 +196,59 @@ def test_ssgd_feature_sharded_matches_dp(mesh_2x4, mesh1, cancer_data):
     )
 
 
+def test_ssgd_feature_sharded_fused_gather_matches_dp(mesh_2x4,
+                                                      cancer_data):
+    """dp×tp WITH the flagship gathered kernel (the two-pass
+    forward/psum/backward split): features over 4 model shards must
+    match the pure-dp one-pass kernel on the same 2-shard data axis —
+    identical block draws, same math, different sharding. Drift is
+    reduction-order only (w norms run ~100 on this unnormalized task,
+    so rtol dominates)."""
+    import jax
+
+    from tpu_distalg.parallel import get_mesh
+
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=100, sampler="fused_gather",
+                          fused_pack=4, gather_block_rows=32,
+                          shuffle_seed=0)
+    tp = ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
+                    dataclasses.replace(cfg, feature_sharded=True))
+    mesh_dp = get_mesh(data=2, devices=jax.devices()[:2])
+    dp = ssgd.train(X_train, y_train, X_test, y_test, mesh_dp, cfg)
+    assert tp.w.shape == dp.w.shape == (31,)
+    np.testing.assert_allclose(
+        np.asarray(tp.w), np.asarray(dp.w), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_ssgd_feature_sharded_fused_checkpoints_bitwise(mesh_2x4,
+                                                        cancer_data,
+                                                        tmp_path):
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=60, sampler="fused_gather",
+                          fused_pack=4, gather_block_rows=32,
+                          shuffle_seed=0, feature_sharded=True)
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4, cfg)
+    seg = ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4, cfg,
+                     checkpoint_dir=str(tmp_path / "tpck"),
+                     checkpoint_every=25)
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(seg.w))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(seg.accs))
+
+
 def test_ssgd_feature_sharded_invalid_combos(mesh_2x4, cancer_data):
     X_train, y_train, X_test, y_test = cancer_data
     with pytest.raises(ValueError, match="feature_sharded"):
         ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
                    ssgd.SSGDConfig(n_iterations=5, feature_sharded=True,
                                    sampler="fixed"))
+    with pytest.raises(ValueError, match="fused"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh_2x4,
+                   ssgd.SSGDConfig(n_iterations=5, feature_sharded=True,
+                                   sampler="fused"))
 
 
 def test_ssgd_eval_every(mesh8, cancer_data):

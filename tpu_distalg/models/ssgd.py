@@ -153,7 +153,10 @@ def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
         if config.sampler != "bernoulli" or config.use_pallas:
             raise ValueError(
                 "feature_sharded composes with the 'bernoulli' sampler "
-                "and the XLA gradient path only"
+                "(this XLA builder) or sampler='fused_gather' (the "
+                "two-pass kernel path, via ssgd.train / "
+                "make_train_fn_fused_tp) — not with "
+                f"sampler={config.sampler!r} use_pallas={config.use_pallas}"
             )
         return _make_train_fn_tp(mesh, config, n_padded)
     if config.sampler == "fixed":
@@ -358,6 +361,156 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     return _build_scan(config, sample_and_grad, prep_xs=prep_xs)
 
 
+def prepare_fused_tp(X_train, y_train, mesh: Mesh, config: SSGDConfig):
+    """dp×tp setup for the gathered kernel: the feature dim is sharded
+    over the mesh model axis. Each model shard packs ITS OWN feature
+    slice (padded to equal width) with the y/v columns replicated into
+    every slice — their weight entries are pinned to zero, so partial
+    matvecs never double-count them and every shard can extract y/v
+    locally. Returns ``(fn, X2, w0, meta)``; the global augmented weight
+    layout is the concatenation of the per-shard ``(d_total,)`` slices,
+    sharded ``P('model')``.
+    """
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+
+    n_data = mesh.shape[DATA_AXIS]
+    n_model = mesh.shape[MODEL_AXIS]
+    d_orig = X_train.shape[1]
+    n = X_train.shape[0]
+    X_np = np.asarray(X_train, np.float32)
+    d_pad = (-d_orig) % n_model
+    if d_pad:
+        X_np = np.pad(X_np, ((0, 0), (0, d_pad)))
+    d_l = X_np.shape[1] // n_model
+
+    packs, meta = [], None
+    for m in range(n_model):
+        # same n/shuffle_seed per slice → identical row permutation and
+        # padding, so slot (i, p) holds the SAME row in every slice
+        X2_m, meta = pallas_kernels.pack_augmented(
+            X_np[:, m * d_l:(m + 1) * d_l], np.asarray(y_train),
+            np.ones(n, np.float32),
+            dtype=jnp.dtype(config.x_dtype), pack=config.fused_pack,
+            block_rows=config.gather_block_rows * n_data,
+            shuffle_seed=config.shuffle_seed,
+        )
+        packs.append(np.asarray(X2_m))
+    X2 = jax.device_put(
+        jnp.asarray(np.concatenate(packs, axis=1)),
+        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
+    )
+    d_t = meta["d_total"]
+    meta = dict(meta, n_model=n_model, d_local=d_l, d_orig=d_orig)
+    w_init = logistic.init_weights(prng.root_key(config.init_seed), d_orig)
+    w_init = np.pad(np.asarray(w_init), (0, d_pad))
+    w0 = np.zeros((n_model * d_t,), np.float32)
+    for m in range(n_model):
+        w0[m * d_t: m * d_t + d_l] = w_init[m * d_l:(m + 1) * d_l]
+    w0 = jax.device_put(jnp.asarray(w0), NamedSharding(mesh, P("model")))
+    fn = make_train_fn_fused_tp(mesh, config, meta)
+    return fn, X2, w0, meta
+
+
+def tp_augment_test_matrix(X_test, meta: dict):
+    """Map test features into the concatenated per-shard augmented
+    layout (zeros at every y/v/pad position — the matching weight
+    entries are held at zero, so the padded matvec equals the original)."""
+    import numpy as np
+
+    d_t, d_l, n_model = meta["d_total"], meta["d_local"], meta["n_model"]
+    X_np = np.asarray(X_test, np.float32)
+    n = X_np.shape[0]
+    out = np.zeros((n, n_model * d_t), np.float32)
+    for m in range(n_model):
+        width = min(d_l, max(0, X_np.shape[1] - m * d_l))
+        out[:, m * d_t: m * d_t + width] = \
+            X_np[:, m * d_l: m * d_l + width]
+    return jnp.asarray(out)
+
+
+def make_train_fn_fused_tp(mesh: Mesh, config: SSGDConfig, meta: dict):
+    """dp×tp scan builder for the gathered kernel — the two-pass split.
+
+    The one-pass kernel cannot feature-shard: the residual needs the
+    GLOBAL matvec ``z = Σ_m X_m·w_m``. So each step runs
+    ``fused_forward_gathered`` (partial z + local y/v on this shard's
+    feature slice), one ``psum(z, 'model')``, then
+    ``fused_backward_gathered`` (residᵀ·X on the slice) — the sampled
+    blocks are read TWICE, i.e. 2× the per-chip HBM bytes of pure dp at
+    equal chip count. Measured on the v5e chip (1M×128 benchmark
+    geometry, model=1 so the split cost is isolated and collectives are
+    free): two-pass 7557 steps/s vs one-pass 8510 — 0.89×, because at
+    this scale the step is dispatch/overhead-bound rather than
+    bandwidth-bound; in the bandwidth-bound regime (≥100M rows) the
+    byte ratio makes it →0.5×. Use dp×tp for CAPACITY (feature width
+    beyond one chip's HBM) — pure dp is the throughput-optimal layout
+    for this workload (SURVEY.md §2.3).
+    """
+    import functools
+
+    from jax import lax
+
+    from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    d_t = meta["d_total"]
+    Pk = meta["pack"]
+    n_shards = mesh.shape[DATA_AXIS]
+    col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(jnp.float32)
+    n_blocks, n_sampled = fused_gather_geometry(config, meta, n_shards)
+    key = prng.root_key(config.seed)
+    fwd = functools.partial(
+        pallas_kernels.fused_forward_gathered,
+        pack=Pk, d_total=d_t, y_col=meta["y_col"], v_col=meta["v_col"],
+        gather_block_rows=config.gather_block_rows, interpret=not on_tpu,
+    )
+    bwd = functools.partial(
+        pallas_kernels.fused_backward_gathered,
+        pack=Pk, d_total=d_t,
+        gather_block_rows=config.gather_block_rows, interpret=not on_tpu,
+    )
+
+    def prep_xs(ts):
+        return jax.vmap(
+            lambda t: sampling.sample_block_ids(
+                jax.random.fold_in(key, t), n_shards, n_blocks, n_sampled,
+            )
+        )(ts)                                        # (T, S, ns)
+
+    def _local_grad(X2, w_l, idx_local):
+        idx = idx_local[0]                           # (ns,)
+        zyv = fwd(X2, w_l, idx)                      # (ns·bp, 3P)
+        z = lax.psum(zyv[:, :Pk], MODEL_AXIS)        # TP matvec
+        y, v = zyv[:, Pk:2 * Pk], zyv[:, 2 * Pk:]    # local (replicated)
+        resid = (jax.nn.sigmoid(z) - y) * v
+        g_l = bwd(X2, resid, idx) * col_keep         # my feature slice
+        g_l = lax.psum(g_l, DATA_AXIS)
+        cnt = lax.psum(jnp.sum(v), DATA_AXIS)
+        return g_l, cnt
+
+    grad_fn = data_parallel(
+        _local_grad, mesh,
+        in_specs=(
+            P("data", "model"),      # concatenated per-slice packs
+            P("model"),              # concatenated augmented weights
+            P("data", None),         # (S, ns) draws → (1, ns) local
+        ),
+        out_specs=(P("model"), P()),
+    )
+
+    def sample_and_grad(X2, y, valid, w, x):
+        del y, valid                 # packed into X2
+        return grad_fn(X2, w, x)
+
+    return _build_scan(config, sample_and_grad, prep_xs=prep_xs)
+
+
 def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """Fixed-size per-shard gather sampling: each shard draws exactly
     ``frac·n_local`` local row indices per step and gathers only those rows
@@ -401,16 +554,19 @@ def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, grad_fn)
 
 
-def _acc_carrying_run_seg(*data_args):
-    """Segment runner shared by the XLA and fused checkpoint paths:
-    state = (w, last_acc); the final emitted accuracy IS the carried
-    last-acc, so resuming with ``acc0`` keeps eval_every>1 histories
-    bitwise-equal across segment boundaries."""
+def _acc_carrying_run_seg(*data_args, w_sharding=None):
+    """Segment runner shared by the XLA, fused and fused-tp checkpoint
+    paths: state = (w, last_acc); the final emitted accuracy IS the
+    carried last-acc, so resuming with ``acc0`` keeps eval_every>1
+    histories bitwise-equal across segment boundaries. ``w_sharding``
+    re-places restored host weights (the tp path's model-sharded w)."""
 
     def run_seg(fn, state, t0):
         w, acc0 = state
-        w, accs = fn(*data_args, jnp.asarray(w), t0=t0,
-                     acc0=jnp.asarray(acc0))
+        w = jnp.asarray(w)
+        if w_sharding is not None:
+            w = jax.device_put(w, w_sharding)
+        w, accs = fn(*data_args, w, t0=t0, acc0=jnp.asarray(acc0))
         return (w, accs[-1]), accs
 
     return run_seg
@@ -439,6 +595,17 @@ def train(
     from jax.sharding import NamedSharding
 
     if config.sampler in ("fused", "fused_gather"):
+        if config.feature_sharded:
+            if config.sampler != "fused_gather":
+                raise ValueError(
+                    "feature_sharded composes with sampler="
+                    "'fused_gather' or 'bernoulli', not 'fused'"
+                )
+            return _train_fused_tp(
+                X_train, y_train, X_test, y_test, mesh, config,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+            )
         return _train_fused(
             X_train, y_train, X_test, y_test, mesh, config,
             checkpoint_dir=checkpoint_dir,
@@ -605,6 +772,53 @@ def prepare_fused_synthetic(
     )
     fn = make_train_fn_fused(mesh, config, meta)
     return fn, X2, w0, meta
+
+
+def tp_extract_weights(w, meta: dict):
+    """Original-layout weights from the concatenated per-shard augmented
+    vector (inverse of :func:`prepare_fused_tp`'s placement)."""
+    import numpy as np
+
+    d_t, d_l = meta["d_total"], meta["d_local"]
+    w_np = np.asarray(w)
+    parts = [w_np[m * d_t: m * d_t + d_l] for m in range(meta["n_model"])]
+    return jnp.asarray(np.concatenate(parts)[: meta["d_orig"]])
+
+
+def _train_fused_tp(
+    X_train, y_train, X_test, y_test, mesh: Mesh, config: SSGDConfig,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
+) -> TrainResult:
+    """dp×tp training with the gathered kernel (two-pass split — see
+    :func:`make_train_fn_fused_tp` for the measured cost vs pure dp)."""
+    fn, X2, w0, meta = prepare_fused_tp(X_train, y_train, mesh, config)
+    X_te = tp_augment_test_matrix(X_test, meta)
+    y_te = jnp.asarray(y_test)
+    dummy = jnp.zeros((1,), jnp.float32)
+    if checkpoint_dir is None:
+        w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
+        metrics.guard_finite(w, "SSGD (fused tp) weights")
+        return TrainResult(w=tp_extract_weights(w, meta), accs=accs)
+
+    from jax.sharding import NamedSharding
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    (w, _), accs, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=lambda seg: make_train_fn_fused_tp(
+            mesh, dataclasses.replace(config, n_iterations=seg), meta),
+        run_seg=_acc_carrying_run_seg(
+            X2, dummy, dummy, X_te, y_te,
+            w_sharding=NamedSharding(mesh, P("model"))),
+        state0=(w0, jnp.float32(0)),
+        tag=f"ssgd:{config.sampler}:tp",
+    )
+    return TrainResult(
+        w=tp_extract_weights(jnp.asarray(w), meta),
+        accs=jnp.asarray(accs),
+    )
 
 
 def _train_fused(

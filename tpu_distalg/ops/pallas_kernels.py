@@ -344,6 +344,143 @@ def fused_grad_sum_gathered(X2, w_aug, block_idx, *, pack: int,
     return g, cnt[0, 0]
 
 
+def _fwd_kernel_gathered(idx_ref, x_ref, c_ref, zyv_ref):
+    """Forward half of the two-pass dp×tp split (see
+    :func:`fused_forward_gathered`): one selector matmul per sampled
+    block, output streamed per block — no accumulator."""
+    del idx_ref
+    zyv_ref[:] = jnp.dot(x_ref[:], c_ref[:],
+                         preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pack", "d_total", "y_col", "v_col",
+                     "gather_block_rows", "interpret"),
+)
+def fused_forward_gathered(X2, w_aug, block_idx, *, pack: int,
+                           d_total: int, y_col: int, v_col: int,
+                           gather_block_rows: int = 1024,
+                           interpret: bool = False):
+    """Forward-only pass over the SAMPLED blocks: returns
+    ``zyv (n_sampled·bp, 3P)`` = [z | y | v] per packed row slot.
+
+    Exists for the dp×tp composition of the gathered sampler
+    (SURVEY.md §2.3 row 6): with the feature dim sharded over the mesh
+    model axis the residual needs the GLOBAL matvec, so the one-pass
+    kernel splits into forward (this) → ``psum(z, 'model')`` → backward
+    (:func:`fused_backward_gathered`). Each model shard packs its own
+    feature slice WITH the y/v columns replicated (their weight entries
+    are pinned to zero, so the partial z never double-counts them) and
+    extracts y/v locally — only z crosses the interconnect. The split
+    reads the sampled blocks twice; see ``ssgd.SSGDConfig`` for the
+    measured cost of that versus pure dp.
+    """
+    P, D = pack, d_total
+    n2, pd = X2.shape
+    bp = gather_block_rows // P
+    if (pd != P * D or (P * D) % 128 or gather_block_rows % P
+            or bp == 0 or n2 % bp or bp % 8):
+        raise ValueError(
+            f"fused_forward_gathered: X2 {X2.shape} incompatible with "
+            f"pack={P}, d_total={D}, gather_block_rows={gather_block_rows}"
+        )
+    C = build_selector(w_aug, pack=P, d_total=D, y_col=y_col,
+                       v_col=v_col, dtype=X2.dtype)
+    n_s = block_idx.shape[0]
+    zyv = pl.pallas_call(
+        _fwd_kernel_gathered,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_s,),
+            in_specs=[
+                pl.BlockSpec((bp, P * D), lambda i, s: (s[i], 0)),
+                pl.BlockSpec((P * D, 3 * P), lambda i, s: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((bp, 3 * P), lambda i, s: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_s * bp, 3 * P), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(block_idx.astype(jnp.int32), X2, C)
+    return zyv
+
+
+def _bwd_kernel_gathered(idx_ref, x_ref, r_ref, gacc_ref, acc_ref,
+                         *, pack: int):
+    """Backward half: accumulate residᵀ·x2 over the sampled blocks (the
+    resid blocks arrive in sampled order, indexed by the grid step)."""
+    del idx_ref
+    P = pack
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x2 = x_ref[:]
+    acc_ref[:] += jax.lax.dot_general(
+        r_ref[:].astype(x2.dtype), x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _done():
+        gacc_ref[:] = acc_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pack", "d_total", "gather_block_rows", "interpret"),
+)
+def fused_backward_gathered(X2, resid, block_idx, *, pack: int,
+                            d_total: int, gather_block_rows: int = 1024,
+                            interpret: bool = False):
+    """Backward pass of the dp×tp split: ``g = Σ residᵀ·x2`` over the
+    sampled blocks, returning the (d_total,) gradient slice for THIS
+    model shard's features. ``resid (n_sampled·bp, P)`` must be in the
+    same sampled-block order :func:`fused_forward_gathered` emitted
+    (slot r of block i at row ``i·bp + r``)."""
+    P, D = pack, d_total
+    n2, pd = X2.shape
+    bp = gather_block_rows // P
+    if (pd != P * D or (P * D) % 128 or gather_block_rows % P
+            or bp == 0 or n2 % bp or bp % 8):
+        raise ValueError(
+            f"fused_backward_gathered: X2 {X2.shape} incompatible with "
+            f"pack={P}, d_total={D}, gather_block_rows={gather_block_rows}"
+        )
+    n_s = block_idx.shape[0]
+    if resid.shape != (n_s * bp, P):
+        raise ValueError(
+            f"resid {resid.shape} != ({n_s * bp}, {P}) sampled layout"
+        )
+    kernel = functools.partial(_bwd_kernel_gathered, pack=P)
+    gacc = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_s,),
+            in_specs=[
+                pl.BlockSpec((bp, P * D), lambda i, s: (s[i], 0)),
+                pl.BlockSpec((bp, P), lambda i, s: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((P, P * D), lambda i, s: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((P, P * D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((P, P * D), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(block_idx.astype(jnp.int32), X2, resid)
+    return jnp.einsum("ccj->j", gacc.reshape(P, P, D))
+
+
 def build_selector(w_aug, *, pack: int, d_total: int, y_col: int,
                    v_col: int, dtype=jnp.bfloat16):
     """The fused constant operand C = [Wbig | Ey | Ev], (P·D, 3P):
