@@ -42,6 +42,59 @@ def test_kmeans_gaussian_mixture_converge_mode(mesh8):
     assert d.mean() < 3.0
 
 
+def test_kmeans_scaled_on_device_recovers_mixture(mesh8):
+    """The scale path: on-device synthesis (build_sharded) + O(k)-host
+    device-side init — no full-dataset host materialization — recovers
+    the generator's true mixture means."""
+    make_rows, true_centers = datasets.gaussian_mixture_rows(
+        k=4, dim=4, seed=3, spread=8.0)
+    # seed=2: an init whose 4 sampled rows land in 4 distinct mixture
+    # components (random-row init can legitimately merge clusters — a
+    # Lloyd local optimum, not a scale-path defect)
+    res = kmeans.fit_scaled(
+        mesh8, 200_000, make_rows,
+        kmeans.KMeansConfig(k=4, n_iterations=10, seed=2),
+    )
+    got = np.asarray(res.centers)
+    want = np.asarray(true_centers())
+    # match clusters by nearest true center; each must be recovered to
+    # ~the noise floor sigma/sqrt(n_k)
+    d = np.linalg.norm(got[:, None, :] - want[None, :, :], axis=-1)
+    assert sorted(d.argmin(axis=1).tolist()) == [0, 1, 2, 3]
+    assert d.min(axis=1).max() < 0.1
+
+
+def test_kmeans_scaled_farthest_init_recovers_k8(mesh8):
+    """Farthest-point init separates all 8 components where random-row
+    init merges with probability 1−8!/8⁸ ≈ 0.998."""
+    make_rows, true_centers = datasets.gaussian_mixture_rows(
+        k=8, dim=8, seed=5, spread=8.0)
+    res = kmeans.fit_scaled(
+        mesh8, 100_000, make_rows,
+        kmeans.KMeansConfig(k=8, n_iterations=10, seed=0,
+                            init="farthest"),
+    )
+    got = np.asarray(res.centers)
+    want = np.asarray(true_centers())
+    d = np.linalg.norm(got[:, None, :] - want[None, :, :], axis=-1)
+    assert sorted(d.argmin(axis=1).tolist()) == list(range(8))
+    assert d.min(axis=1).max() < 0.15
+
+
+def test_kmeans_init_centers_from_rows_matches_data(mesh8):
+    """Regenerated init centers ARE dataset rows (takeSample parity)."""
+    make_rows, _ = datasets.gaussian_mixture_rows(k=2, dim=3, seed=1)
+    import jax.numpy as jnp
+
+    c0 = kmeans.init_centers_from_rows(make_rows, 1000, 5, seed=7)
+    assert c0.shape == (5, 3)
+    import jax
+
+    all_rows = np.asarray(jax.jit(make_rows)(jnp.arange(1000)))
+    for row in np.asarray(c0):
+        assert np.any(np.all(np.isclose(all_rows, row, atol=1e-6), axis=1))
+
+
 def test_kmeans_empty_cluster_keeps_old_center(mesh8):
     """A center with no points must survive unchanged (k-means.py:66-71
     only overwrites ids present in the collect)."""

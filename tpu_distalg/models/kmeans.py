@@ -33,6 +33,10 @@ class KMeansConfig:
     converge_dist: float | None = None  # None → fixed iters (parity)
     max_iterations: int = 1000          # safety cap in converge mode
     seed: int = 42
+    # scale-path init: 'sample' = k random rows (takeSample parity,
+    # k-means.py:53); 'farthest' = greedy max-min over an oversample
+    # (immune to the merged-cluster local optimum at larger k)
+    init: str = "sample"
 
 
 @dataclasses.dataclass
@@ -105,12 +109,92 @@ def make_fit_fn(mesh: Mesh, config: KMeansConfig):
     return jax.jit(fit)
 
 
+def init_centers_from_rows(make_rows, n_rows: int, k: int,
+                           seed: int) -> jax.Array:
+    """Device-side seeded init for the scale path: draw k DISTINCT
+    global row ids host-side (O(k) memory — the ids, never the data)
+    and REGENERATE exactly those rows with the counter-based generator.
+    Because row content depends only on the row id, this equals
+    ``takeSample(False, k, seed)`` over the materialized dataset
+    (``k-means.py:53``) without a host copy or a cross-shard gather."""
+    if k > n_rows:
+        raise ValueError(
+            f"cannot sample k={k} distinct rows from n_rows={n_rows}"
+        )
+    rng = np.random.default_rng(seed)
+    chosen: list[int] = []
+    seen: set[int] = set()
+    while len(chosen) < k:
+        for i in rng.integers(0, n_rows, size=k).tolist():
+            if i not in seen and len(chosen) < k:
+                seen.add(i)
+                chosen.append(i)
+    ids = jnp.asarray(np.array(chosen), jnp.int32)
+    return jnp.asarray(jax.jit(make_rows)(ids), jnp.float32)
+
+
+def init_centers_farthest(make_rows, n_rows: int, k: int, seed: int,
+                          oversample: int = 32) -> jax.Array:
+    """Farthest-point init for the scale path: regenerate ``oversample·k``
+    candidate rows (still O(k) in ``n_rows``) and greedily pick k by
+    max-min distance. Random-row init (``init_centers_from_rows``, the
+    reference's ``takeSample`` parity) merges clusters with probability
+    ≈1−k!/kᵏ on a balanced mixture; farthest-point avoids that Lloyd
+    local optimum while staying a one-shot init, no extra data pass."""
+    rng = np.random.default_rng(seed)
+    m = oversample * k
+    ids = jnp.asarray(
+        rng.integers(0, n_rows, size=m, dtype=np.int64), jnp.int32)
+    cand = np.asarray(jax.jit(make_rows)(ids), np.float32)  # (m, dim)
+    chosen = [int(rng.integers(0, m))]
+    d = np.linalg.norm(cand - cand[chosen[0]], axis=1)
+    while len(chosen) < k:
+        nxt = int(d.argmax())
+        chosen.append(nxt)
+        d = np.minimum(d, np.linalg.norm(cand - cand[nxt], axis=1))
+    return jnp.asarray(cand[chosen])
+
+
 def fit(points: np.ndarray, mesh: Mesh,
         config: KMeansConfig = KMeansConfig()) -> KMeansResult:
     ps = parallelize(points, mesh)
     centers0 = init_centers(points, config.k, config.seed)
     fn = make_fit_fn(mesh, config)
     centers, assign, n_run = fn(ps.data, ps.mask, jnp.asarray(centers0))
+    return KMeansResult(
+        centers=centers, assignments=assign, n_iterations_run=int(n_run)
+    )
+
+
+def init_centers_scaled(make_rows, n_rows: int,
+                        config: KMeansConfig) -> jax.Array:
+    """The scale path's ``config.init`` dispatch — one place, shared by
+    :func:`fit_scaled` and bench.py (which times the fit separately)."""
+    if config.init == "farthest":
+        return init_centers_farthest(
+            make_rows, n_rows, config.k, config.seed)
+    if config.init == "sample":
+        return init_centers_from_rows(
+            make_rows, n_rows, config.k, config.seed)
+    raise ValueError(f"unknown init {config.init!r}")
+
+
+def fit_scaled(mesh: Mesh, n_rows: int, make_rows,
+               config: KMeansConfig = KMeansConfig()) -> KMeansResult:
+    """Scale-out fit: the dataset is synthesized ON DEVICE, shard by
+    shard (``parallel.build_sharded``), and the init centers are
+    regenerated from k row ids — host memory is O(k) in ``n_rows``,
+    unlike :func:`fit`, which (like the reference's driver-side
+    ``np.concatenate`` + ``parallelize``, ``k-means.py:49-53``) tops
+    out at host RAM. ``make_rows(row_ids) -> (n, dim)`` must be
+    jittable and counter-based (e.g.
+    ``datasets.gaussian_mixture_rows``)."""
+    from tpu_distalg.parallel import build_sharded
+
+    ps = build_sharded(mesh, n_rows, make_rows)
+    centers0 = init_centers_scaled(make_rows, n_rows, config)
+    fn = make_fit_fn(mesh, config)
+    centers, assign, n_run = fn(ps.data, ps.mask, centers0)
     return KMeansResult(
         centers=centers, assignments=assign, n_iterations_run=int(n_run)
     )

@@ -96,6 +96,41 @@ def gaussian_mixture(
     return (centers[assign] + rng.normal(size=(n_rows, dim))).astype(np.float32)
 
 
+def gaussian_mixture_rows(k: int = 4, dim: int = 2, seed: int = 0,
+                          spread: float = 8.0):
+    """Jittable per-row Gaussian-mixture generator for
+    ``parallel.build_sharded`` — the host-memory-free sibling of
+    :func:`gaussian_mixture` (counter-based per-row PRNG: content
+    depends only on the global row id, not the shard topology).
+    Returns ``(make_rows, true_centers_fn)``: ``make_rows(row_ids) ->
+    (n, dim) points``; ``true_centers_fn()`` the mixture means, for
+    recovery checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_distalg.utils import prng
+
+    key = prng.root_key(seed)
+    k_c, k_rows = jax.random.fold_in(key, 0), jax.random.fold_in(key, 1)
+
+    def true_centers():
+        return jax.random.normal(k_c, (k, dim)) * spread
+
+    def make_rows(ids):
+        centers = true_centers()
+        row_keys = jax.vmap(lambda i: jax.random.fold_in(k_rows, i))(ids)
+        assign = jax.vmap(
+            lambda rk: jax.random.randint(rk, (), 0, k)
+        )(row_keys)
+        noise = jax.vmap(
+            lambda rk: jax.random.normal(
+                jax.random.fold_in(rk, 1), (dim,))
+        )(row_keys)
+        return centers[assign] + noise
+
+    return make_rows, true_centers
+
+
 def erdos_renyi_edges(
     n_vertices: int, avg_degree: float = 8.0, seed: int = 0
 ) -> np.ndarray:
