@@ -13,9 +13,16 @@ all-to-all sequence parallelism), so these are first-class here:
     accumulation (Liu et al. ring attention; Milakov-Gimelshein online
     softmax): Q stays put, K/V blocks rotate; memory per chip is
     O(S_local²) instead of O(S²), so sequence length scales linearly with
-    the ring size.
+    the ring size. Multi-head and causal decoding are supported — the
+    full surface a decoder block needs.
+  * ``ulysses_attention`` — DeepSpeed-Ulysses sequence parallelism: one
+    ``all_to_all`` re-shards sequence→heads, every chip runs dense
+    attention on its own heads over the FULL sequence, and the inverse
+    ``all_to_all`` restores sequence sharding. Cheaper in collective
+    volume than the ring when the head count divides the axis; the ring
+    wins on peak memory (Ulysses materialises full-sequence K/V).
 
-Both are shard_map bodies: run them inside ``data_parallel`` with
+All are shard_map bodies: run them inside ``data_parallel`` with
 sequence-sharded operands.
 """
 
@@ -57,40 +64,73 @@ def ring_allgather_matmul(a_local, b_local, axis_name: str = DATA_AXIS):
     return out
 
 
+def _online_update(qh, o, m, l, kh, vh, scale, mask):
+    """One online-softmax accumulation step over a resident K/V chunk.
+
+    ``qh``: (H, Sq, d); ``kh, vh``: (H, C, d); state ``o``: (H, Sq, d),
+    ``m, l``: (H, Sq). ``mask``: (Sq, C) boolean (True = attend) or None.
+    Fully-masked rows are handled safely: while ``m`` is still −inf the
+    rescale factor and probabilities are forced to 0 instead of exp(−inf −
+    −inf) = NaN.
+    """
+    scores = jnp.einsum(
+        "hqd,hkd->hqk", qh, kh, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None], scores, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    safe = ~jnp.isneginf(m_new)
+    alpha = jnp.where(safe, jnp.exp(m - m_new), 0.0)
+    p = jnp.where(
+        safe[..., None], jnp.exp(scores - m_new[..., None]), 0.0
+    )
+    l = l * alpha + jnp.sum(p, axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "hqk,hkd->hqd", p.astype(vh.dtype), vh,
+        preferred_element_type=jnp.float32,
+    )
+    return o, m_new, l
+
+
 def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
                    scale: float | None = None,
-                   kv_chunk: int | None = None):
+                   kv_chunk: int | None = None,
+                   causal: bool = False):
     """Exact attention over a sequence sharded around the ring.
 
-    ``q, k, v``: (S_local, d) per shard. K/V blocks rotate; each arrival
-    updates the online-softmax state (running max m, normalizer l,
-    accumulator o) so the result is exactly ``softmax(QKᵀ/√d)·V`` over
-    the FULL sequence.
+    ``q, k, v``: (S_local, d) single-head or (S_local, H, d) multi-head
+    per shard, sequence-sharded in ring order (shard i holds global
+    positions [i·S_local, (i+1)·S_local)). K/V blocks rotate; each
+    arrival updates the online-softmax state (running max m, normalizer
+    l, accumulator o) so the result is exactly ``softmax(QKᵀ/√d)·V`` over
+    the FULL sequence, per head.
+
+    ``causal=True`` applies the decoder mask on GLOBAL positions: query
+    p attends to keys ≤ p. Blocks that arrive from a later shard are
+    fully masked and skipped outright (``lax.cond`` around the compute —
+    the ppermute still runs, keeping the ring in lockstep). The skip
+    saves the FLOPs but not the wall-clock imbalance (shard n−1 computes
+    n partial blocks while shard 0 computes 1); a zigzag/striped
+    placement would rebalance it and is intentionally not done here —
+    it changes the position↔shard map that every caller lays data
+    out with.
 
     ``kv_chunk`` bounds the materialised score tile: the resident K/V
     block is processed in flash-attention-style chunks of that many keys
     (a ``lax.scan`` applying the same online-softmax update), so peak
-    memory is O(S_local · kv_chunk) instead of O(S_local²) — at
+    memory is O(S_local · kv_chunk) per head instead of O(S_local²) — at
     S_local = 32k a full score block is 4 GB and out of HBM, while
     kv_chunk = 1024 keeps it at 128 MB. ``None`` processes whole blocks
     (fine for short sequences; fewer, larger MXU calls).
     """
+    single = q.ndim == 2
+    if single:
+        q, k, v = (x[:, None, :] for x in (q, k, v))
     n = lax.axis_size(axis_name)
-    d = q.shape[-1]
+    my = lax.axis_index(axis_name)
+    s_q, h, d = q.shape
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-
-    def online_update(o, m, l, kc, vc):
-        scores = jnp.dot(q, kc.T, preferred_element_type=jnp.float32) * s
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
-        # rescale previous accumulator to the new max
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[:, None] + jnp.dot(
-            p.astype(vc.dtype), vc, preferred_element_type=jnp.float32
-        )
-        return o, m_new, l
-
+    qh = jnp.moveaxis(q, 1, 0)                     # (H, Sq, d)
     s_local = k.shape[0]
     if kv_chunk is not None and (
         kv_chunk < 1 or (kv_chunk < s_local and s_local % kv_chunk)
@@ -101,35 +141,105 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             f"kv_chunk={kv_chunk} must be >= 1 and divide the local "
             f"K/V length {s_local}"
         )
+    q_pos = my * s_q + jnp.arange(s_q)             # global query positions
 
-    def process_block(kb, vb, o, m, l):
+    def process_block(kh, vh, o, m, l, src):
+        # kh, vh: (H, S_local, d) — transposed ONCE before the ring loop;
+        # ppermute commutes with the transpose, so blocks rotate in this
+        # layout and no per-ring-step relayout is paid
         if kv_chunk is None or kv_chunk >= s_local:
-            return online_update(o, m, l, kb, vb)
+            mask = None
+            if causal:
+                k_pos = src * s_local + jnp.arange(s_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+            return _online_update(qh, o, m, l, kh, vh, s, mask)
         n_chunks = s_local // kv_chunk
+        kc = kh.reshape(h, n_chunks, kv_chunk, d).transpose(1, 0, 2, 3)
+        vc = vh.reshape(h, n_chunks, kv_chunk, d).transpose(1, 0, 2, 3)
 
-        def chunk_step(carry, kv):
-            kc, vc = kv
-            return online_update(*carry, kc, vc), None
+        def chunk_step(carry, xs):
+            kcc, vcc, c = xs
+            mask = None
+            if causal:
+                k_pos = (src * s_local + c * kv_chunk
+                         + jnp.arange(kv_chunk))
+                mask = q_pos[:, None] >= k_pos[None, :]
+            return _online_update(qh, *carry, kcc, vcc, s, mask), None
 
         (o, m, l), _ = lax.scan(
-            chunk_step, (o, m, l),
-            (kb.reshape(n_chunks, kv_chunk, d),
-             vb.reshape(n_chunks, kv_chunk, d)),
+            chunk_step, (o, m, l), (kc, vc, jnp.arange(n_chunks))
         )
         return o, m, l
 
     def body(i, carry):
-        kb, vb, o, m, l = carry
-        o, m, l = process_block(kb, vb, o, m, l)
-        kb = lax.ppermute(kb, axis_name, _ring_perm(n))
-        vb = lax.ppermute(vb, axis_name, _ring_perm(n))
-        return kb, vb, o, m, l
+        kh, vh, o, m, l = carry
+        # the block currently resident came from shard (my - i) mod n
+        src = (my - i) % n
+        if causal:
+            o, m, l = lax.cond(
+                src <= my,
+                lambda oml: process_block(kh, vh, *oml, src),
+                lambda oml: oml,
+                (o, m, l),
+            )
+        else:
+            o, m, l = process_block(kh, vh, o, m, l, src)
+        kh = lax.ppermute(kh, axis_name, _ring_perm(n))
+        vh = lax.ppermute(vh, axis_name, _ring_perm(n))
+        return kh, vh, o, m, l
 
-    o0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
-    m0 = jnp.full((q.shape[0],), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
-    _, _, o, _, l = lax.fori_loop(0, n, body, (k, v, o0, m0, l0))
-    return o / l[:, None]
+    o0 = jnp.zeros((h, s_q, d), dtype=jnp.float32)
+    m0 = jnp.full((h, s_q), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((h, s_q), dtype=jnp.float32)
+    kh0 = jnp.moveaxis(k, 1, 0)                    # (H, S_local, d)
+    vh0 = jnp.moveaxis(v, 1, 0)
+    _, _, o, _, l = lax.fori_loop(0, n, body, (kh0, vh0, o0, m0, l0))
+    out = jnp.moveaxis(o / l[..., None], 0, 1)     # (Sq, H, d)
+    return out[:, 0, :] if single else out
+
+
+def softmax_attention(q, k, v, *, scale: float | None = None,
+                      causal: bool = False):
+    """Dense reference attention, (S, H, d) × (T, H, d) → (S, H, d).
+
+    Materialises the full (H, S, T) score tensor — the local compute of
+    :func:`ulysses_attention` and the oracle the ring variants are tested
+    against. Use the ring for long sequences.
+    """
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum(
+        "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
+    ) * s
+    if causal:
+        mask = (jnp.arange(q.shape[0])[:, None]
+                >= jnp.arange(k.shape[0])[None, :])
+        scores = jnp.where(mask[None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "hqk,khd->qhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def ulysses_attention(q, k, v, axis_name: str = DATA_AXIS, *,
+                      scale: float | None = None, causal: bool = False):
+    """DeepSpeed-Ulysses sequence-parallel attention.
+
+    ``q, k, v``: (S_local, H, d) sequence-sharded. One ``all_to_all``
+    re-shards to (S, H_local, d) — every chip holds the FULL sequence for
+    H/n of the heads — dense attention runs locally per head (positions
+    are global, so ``causal`` needs no cross-shard bookkeeping), and the
+    inverse exchange restores (S_local, H, d). Exact; requires H
+    divisible by the axis size. Peak memory is O(S²·H/n) for the score
+    tensor — prefer :func:`ring_attention` when S_local² is the binding
+    constraint.
+    """
+    qh = alltoall_seq_to_head(q, axis_name)
+    kh = alltoall_seq_to_head(k, axis_name)
+    vh = alltoall_seq_to_head(v, axis_name)
+    o = softmax_attention(qh, kh, vh, scale=scale, causal=causal)
+    return alltoall_head_to_seq(o, axis_name)
 
 
 def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
@@ -146,3 +256,20 @@ def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
     out = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
                          tiled=False)
     return out.reshape(n * s_l, h // n, d)
+
+
+def alltoall_head_to_seq(x, axis_name: str = DATA_AXIS):
+    """Inverse of :func:`alltoall_seq_to_head`: (S, H_local, d)
+    head-sharded → (S_local, H, d) sequence-sharded, in one all_to_all.
+    ``alltoall_head_to_seq(alltoall_seq_to_head(x))`` is the identity."""
+    n = lax.axis_size(axis_name)
+    s, h_l, d = x.shape
+    if s % n:
+        raise ValueError(
+            f"alltoall_head_to_seq: sequence length {s} must be "
+            f"divisible by the '{axis_name}' axis size {n}"
+        )
+    x = x.reshape(n, s // n, h_l, d)
+    out = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                         tiled=False)
+    return out.reshape(s // n, n * h_l, d)
