@@ -74,3 +74,8 @@ if __name__ == "__main__":
                 C(n_iterations=N_STEPS, eval_test=False,
                   sampler="fused_gather", gather_block_rows=8192,
                   x_dtype="bfloat16", shuffle_seed=0, init_seed=7))
+    probe_fused("fused_train bf16 (megakernel)",
+                C(n_iterations=N_STEPS, eval_test=False,
+                  sampler="fused_train", gather_block_rows=8192,
+                  mega_steps=100, x_dtype="bfloat16", shuffle_seed=0,
+                  init_seed=7))

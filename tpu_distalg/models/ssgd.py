@@ -61,7 +61,11 @@ class SSGDConfig:
     # 'fused_gather' = the traffic-proportional kernel: sample whole
     # gather_block_rows-row blocks XLA-side, DMA ONLY those blocks
     # (≈frac× the HBM bytes of 'fused'; block-cluster sampling — i.i.d.
-    # per-row equivalent when rows are i.i.d. or pack-time shuffled).
+    # per-row equivalent when rows are i.i.d. or pack-time shuffled);
+    # 'fused_train' = 'fused_gather' with the WHOLE schedule fused into
+    # one kernel launch per mega_steps segment (weights live in VMEM,
+    # update runs in-kernel): fastest path, but single-data-shard only
+    # (no per-step psum), lam=0 only, eval at segment boundaries only.
     # Precision note: with x_dtype='bfloat16' the fused kernels cast the
     # residual AND the selector-replicated weights to bf16 (the XLA bf16
     # path keeps both f32) — a small extra deviation; convergence to the
@@ -70,6 +74,7 @@ class SSGDConfig:
     fused_pack: int = 16        # rows packed per sublane row ('fused*')
     fused_block_rows: int = 8192
     gather_block_rows: int = 1024   # rows per sampled block ('fused_gather')
+    mega_steps: int = 125       # steps per kernel launch ('fused_train')
     shuffle_seed: int | None = None  # pack-time row shuffle ('fused_gather')
     # shard the FEATURE dim over the mesh model axis (tensor parallelism):
     # the forward matvec psums partial X_l·w_l over 'model', the gradient
@@ -296,6 +301,9 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     n_shards = mesh.shape[DATA_AXIS]
     prep_xs = None
 
+    if config.sampler == "fused_train":
+        return _make_train_fn_mega(mesh, config, meta, on_tpu, n_shards)
+
     if config.sampler == "fused_gather":
         # geometry warns when n_blocks quantizes the fraction coarsely
         n_blocks, n_sampled = fused_gather_geometry(
@@ -359,6 +367,94 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
         return grad_fn(X2, w, x)
 
     return _build_scan(config, sample_and_grad, prep_xs=prep_xs)
+
+
+def _make_train_fn_mega(mesh: Mesh, config: SSGDConfig, meta: dict,
+                        on_tpu: bool, n_shards: int):
+    """'fused_train' scan builder: the whole schedule in
+    ``pallas_kernels.fused_train_gathered`` megakernel launches of
+    ``mega_steps`` SGD steps each (weights in VMEM, update in-kernel).
+
+    Sampling is IDENTICAL to 'fused_gather' (same
+    ``sampling.sample_block_ids`` draw keyed on the absolute step id, so
+    checkpoint/resume stays bitwise) and the update math is the same
+    f32-master/bf16-selector structure, so the two samplers agree to
+    float rounding — asserted by ``tests/test_mega_kernel.py``. The
+    per-step psum is the one thing a single launch cannot express, hence
+    the single-data-shard restriction.
+    """
+    from tpu_distalg.ops import pallas_kernels
+
+    n_blocks, n_sampled = fused_gather_geometry(config, meta, n_shards)
+    if n_shards != 1:
+        raise ValueError(
+            "sampler='fused_train' fuses the whole schedule into one "
+            "kernel launch, so there is no per-step cross-shard psum: "
+            "it is the single-data-shard (dp=1) specialization. Use "
+            "'fused_gather' on multi-shard data meshes."
+        )
+    if config.lam != 0.0:
+        raise ValueError(
+            "sampler='fused_train' supports lam=0 only (the reference "
+            "default, ssgd.py:21); use 'fused_gather' for regularized "
+            "runs"
+        )
+    T = config.n_iterations
+    mega = min(config.mega_steps, T)
+    if T % mega:
+        raise ValueError(
+            f"sampler='fused_train' needs n_iterations ({T}) divisible "
+            f"by mega_steps ({mega})"
+        )
+    if config.eval_test and config.eval_every != mega:
+        raise ValueError(
+            "sampler='fused_train' evaluates at kernel-segment "
+            f"boundaries only: set eval_every == mega_steps ({mega}) "
+            "or eval_test=False"
+        )
+    d_t = meta["d_total"]
+    key = prng.root_key(config.seed)
+    kern = functools.partial(
+        pallas_kernels.fused_train_gathered,
+        pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+        v_col=meta["v_col"],
+        gather_block_rows=config.gather_block_rows,
+        eta=config.eta, interpret=not on_tpu,
+    )
+
+    def train(X2, y, valid, X_test, y_test, w0, t0=0, acc0=0.0):
+        del y, valid  # labels/validity ride inside the packed X2
+        ts = jnp.arange(T) + t0
+        idx = jax.vmap(
+            lambda t: sampling.sample_block_ids(
+                jax.random.fold_in(key, t), 1, n_blocks, n_sampled)
+        )(ts).reshape(T // mega, mega, n_sampled)
+        w_tile0 = jnp.tile(w0, (meta["pack"],))[:, None]
+
+        def seg(wt, idx_seg):
+            wt = kern(X2, wt, idx_seg)
+            acc = (
+                metrics.binary_accuracy(X_test @ wt[:d_t, 0], y_test)
+                if config.eval_test else jnp.float32(0)
+            )
+            return wt, acc
+
+        w_tile, seg_accs = jax.lax.scan(seg, w_tile0, idx)
+        w = w_tile[:d_t, 0]
+        if config.eval_test:
+            # eval_every-style history: position t carries the last acc
+            # computed at or before t (segment ends), seeded with acc0
+            prev = jnp.concatenate(
+                [jnp.asarray(acc0, jnp.float32).reshape(1),
+                 seg_accs[:-1]]
+            )
+            accs = jnp.repeat(prev, mega).at[mega - 1::mega].set(
+                seg_accs)
+        else:
+            accs = jnp.zeros((T,), jnp.float32)
+        return w, accs
+
+    return jax.jit(train)
 
 
 def prepare_fused_tp(X_train, y_train, mesh: Mesh, config: SSGDConfig):
@@ -594,12 +690,13 @@ def train(
     from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
     from jax.sharding import NamedSharding
 
-    if config.sampler in ("fused", "fused_gather"):
+    if config.sampler in ("fused", "fused_gather", "fused_train"):
         if config.feature_sharded:
             if config.sampler != "fused_gather":
                 raise ValueError(
                     "feature_sharded composes with sampler="
-                    "'fused_gather' or 'bernoulli', not 'fused'"
+                    "'fused_gather' or 'bernoulli', not "
+                    f"'{config.sampler}'"
                 )
             return _train_fused_tp(
                 X_train, y_train, X_test, y_test, mesh, config,
@@ -676,7 +773,7 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     d_orig = X_train.shape[1]
     n = X_train.shape[0]
     block = (config.gather_block_rows
-             if config.sampler == "fused_gather"
+             if config.sampler in ("fused_gather", "fused_train")
              else config.fused_block_rows)
     X2, meta = pallas_kernels.pack_augmented(
         np.asarray(X_train), np.asarray(y_train), np.ones(n, np.float32),
@@ -729,7 +826,7 @@ def prepare_fused_synthetic(
     d = n_features + 1  # + bias column (ssgd.py:83-84)
     d_t, y_col, v_col = pallas_kernels.packed_dims(d, pk)
     block = (config.gather_block_rows
-             if config.sampler == "fused_gather"
+             if config.sampler in ("fused_gather", "fused_train")
              else config.fused_block_rows)
     mult = max(block, pk) * n_shards
     n_t = n_rows + ((-n_rows) % mult)

@@ -344,6 +344,181 @@ def fused_grad_sum_gathered(X2, w_aug, block_idx, *, pack: int,
     return g, cnt[0, 0]
 
 
+def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
+                           ew3_ref, eyv_ref, w0_ref, wout_ref,
+                           c_ref, wm_ref, acc_ref, cacc_ref, *,
+                           pack: int, eta: float, n_sampled: int,
+                           sel_dtype):
+    """v5 body: T SGD steps in ONE kernel launch (see
+    :func:`fused_train_gathered`). Grid (T, n_sampled); the weight
+    master ``wm`` (P·D, 1) f32 and the bf16 selector ``c`` live in VMEM
+    scratch across ALL grid steps, so between-step cost is zero — no
+    kernel relaunch, no XLA glue, no HBM round-trip for the model state.
+
+    The in-kernel update avoids cross-lane transposes (expensive
+    relayouts on TPU) by expressing the gradient fold and the selector
+    rebuild as small matmuls/reductions against constant operands:
+      y    (P, D)    = (acc ⊙ Msel) · S      — per-slot diagonal band
+      grow (1, D)    = Σ_sublanes y          — the gradient, lane-major
+      gcol (D, 1)    = Σ_lanes (I_D ⊙ grow)  — transposed via mask+reduce
+      Δw   (P·D, 1)  = S · gcol              — tiled to every slot
+      C              = bf16(wm ⊙ Ew3) + EyEv — selector rebuilt in place
+    """
+    P = pack
+    t = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when((t == 0) & (i == 0))
+    def _first():
+        wm_ref[:] = w0_ref[:]
+        c_ref[:] = (
+            jnp.broadcast_to(w0_ref[:], c_ref.shape) * ew3_ref[:]
+        ).astype(sel_dtype) + eyv_ref[:]
+
+    @pl.when(i == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        cacc_ref[0, 0] = 0.0
+
+    x2 = x_ref[:]                                   # (bp, P·D), ONE read
+    zyv = jnp.dot(x2, c_ref[:], preferred_element_type=jnp.float32)
+    z, y, v = zyv[:, :P], zyv[:, P:2 * P], zyv[:, 2 * P:3 * P]
+    resid = ((jax.nn.sigmoid(z) - y) * v).astype(x2.dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        resid, x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                               # (P, P·D) MXU
+    cacc_ref[0, 0] += jnp.sum(v)
+
+    @pl.when(i == n_sampled - 1)
+    def _update():
+        nb = jnp.maximum(cacc_ref[0, 0], 1.0)       # empty-sample guard
+        yband = jnp.dot(acc_ref[:] * msel_ref[:], s_ref[:],
+                        preferred_element_type=jnp.float32)  # (P, D)
+        grow = jnp.sum(yband, axis=0, keepdims=True)          # (1, D)
+        gcol = jnp.sum(eye_ref[:] * grow, axis=1, keepdims=True)
+        wm_ref[:] = wm_ref[:] - (eta / nb) * jnp.dot(
+            s_ref[:], gcol, preferred_element_type=jnp.float32)
+        c_ref[:] = (
+            jnp.broadcast_to(wm_ref[:], c_ref.shape) * ew3_ref[:]
+        ).astype(sel_dtype) + eyv_ref[:]
+
+    @pl.when((t == pl.num_programs(0) - 1) & (i == n_sampled - 1))
+    def _done():
+        wout_ref[:] = wm_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pack", "d_total", "y_col", "v_col",
+                     "gather_block_rows", "eta", "interpret"),
+)
+def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
+                         d_total: int, y_col: int, v_col: int,
+                         gather_block_rows: int, eta: float,
+                         interpret: bool = False):
+    """T block-sampled SGD steps in ONE pallas_call (v5, "megakernel").
+
+    The v4 kernel (:func:`fused_grad_sum_gathered`) made HBM traffic
+    proportional to the minibatch, but still paid a fixed per-STEP cost:
+    one Mosaic launch (~8 µs) plus the XLA update glue (~3 µs) against
+    ~33 µs of DMA at bench scale — ~25% of the step. Here the grid is
+    ``(T, n_sampled)``: the weight master and the selector C live in
+    VMEM scratch across the whole schedule, the SGD update runs
+    in-kernel at each block-row boundary, and the launch cost amortizes
+    over T steps. Per-step work collapses to the minibatch DMA.
+
+    Semantics are EXACTLY the per-step 'fused_gather' path for the
+    ``lam=0``, single-data-shard case (the per-step psum is the one
+    thing a single kernel cannot do — use 'fused_gather' for dp>1):
+    same block-cluster sampling (the caller draws ``block_idx`` with the
+    same PRNG), same f32 weight master quantizing to a bf16 selector per
+    step, same ``w −= η·g_masked/max(cnt,1)`` update with the y/v/pad
+    columns held at zero (baked into the Ew3 mask — valid because the
+    augmented w0 tail is zero and its gradient is masked).
+
+    ``w_tile0``: (P·D, 1) f32, the augmented weights tiled per slot
+    (``jnp.tile(w_aug, P)[:, None]``). ``block_idx``: (T, n_sampled)
+    int32. Returns the final (P·D, 1) weight tile; row j of any slot c
+    (``tile[c*D+j, 0]``) is ``w_aug[j]``.
+    """
+    P, D = pack, d_total
+    n2, pd = X2.shape
+    bp = gather_block_rows // P
+    if (pd != P * D or (P * D) % 128 or gather_block_rows % P
+            or bp == 0 or n2 % bp):
+        raise ValueError(
+            f"fused_train_gathered: X2 {X2.shape} incompatible with "
+            f"pack={P}, d_total={D}, gather_block_rows={gather_block_rows}"
+        )
+    if bp % 8:
+        raise ValueError(
+            f"gather_block_rows={gather_block_rows} gives {bp} packed "
+            f"rows per block; need a multiple of 8·pack={8 * P} rows"
+        )
+    T, n_sampled = block_idx.shape
+
+    # constant operands of the in-kernel update (built once per trace;
+    # XLA hoists them out of any enclosing scan)
+    colmask = (jnp.arange(D) < y_col).astype(jnp.float32)      # (D,)
+    eyeP = jnp.eye(P, dtype=jnp.float32)
+    # Msel (P, P·D): 1 at [c, c·D+j] for kept j — the diagonal band of
+    # the acc tile, with the y/v/pad gradient columns zeroed
+    msel = (eyeP[:, :, None] * colmask[None, None, :]).reshape(P, P * D)
+    # S (P·D, D): identity stacked P times — tiles (D,·) to (P·D,·)
+    s_tile = jnp.tile(jnp.eye(D, dtype=jnp.float32), (P, 1))
+    eye_d = jnp.eye(D, dtype=jnp.float32)
+    # Ew3 (P·D, 3P): w-selector ones in the first P columns (colmasked
+    # rows); zeros over the Ey/Ev columns
+    ew = (eyeP[:, None, :] * colmask[None, :, None]).reshape(P * D, P)
+    ew3 = jnp.concatenate(
+        [ew, jnp.zeros((P * D, 2 * P), jnp.float32)], axis=1)
+    # EyEv (P·D, 3P) in X2's dtype: zeros over the w columns
+    ey = (eyeP[:, None, :] * jax.nn.one_hot(y_col, D, dtype=X2.dtype)[
+        None, :, None]).reshape(P * D, P)
+    ev = (eyeP[:, None, :] * jax.nn.one_hot(v_col, D, dtype=X2.dtype)[
+        None, :, None]).reshape(P * D, P)
+    eyv = jnp.concatenate(
+        [jnp.zeros((P * D, P), X2.dtype), ey, ev], axis=1
+    ).astype(X2.dtype)  # eyeP is f32; the products promote
+
+    kernel = functools.partial(
+        _train_kernel_gathered, pack=P, eta=eta, n_sampled=n_sampled,
+        sel_dtype=X2.dtype)
+    whole = lambda t, i, s: (0, 0)  # noqa: E731 — resident constants
+    wout = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(T, n_sampled),
+            in_specs=[
+                pl.BlockSpec((bp, P * D), lambda t, i, s: (s[t, i], 0)),
+                pl.BlockSpec((P, P * D), whole),       # Msel
+                pl.BlockSpec((P * D, D), whole),       # S
+                pl.BlockSpec((D, D), whole),           # I_D
+                pl.BlockSpec((P * D, 3 * P), whole),   # Ew3
+                pl.BlockSpec((P * D, 3 * P), whole),   # EyEv
+                pl.BlockSpec((P * D, 1), whole),       # w_tile0
+            ],
+            out_specs=pl.BlockSpec((P * D, 1), whole),
+            scratch_shapes=[
+                pltpu.VMEM((P * D, 3 * P), X2.dtype),   # C
+                pltpu.VMEM((P * D, 1), jnp.float32),    # weight master
+                pltpu.VMEM((P, P * D), jnp.float32),    # grad acc
+                pltpu.SMEM((1, 1), jnp.float32),        # count acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((P * D, 1), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(block_idx.astype(jnp.int32), X2, msel, s_tile, eye_d, ew3, eyv,
+      w_tile0)
+    return wout
+
+
 def _fwd_kernel_gathered(idx_ref, x_ref, c_ref, zyv_ref):
     """Forward half of the two-pass dp×tp split (see
     :func:`fused_forward_gathered`): one selector matmul per sampled
