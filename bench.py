@@ -9,11 +9,13 @@ Metrics (BASELINE.json):
   2. PageRank iterations/sec on a 1M-vertex, ~8M-edge Erdős–Rényi graph
      (``graph_computation/pagerank.py:50-57`` at benchmark scale).
 
-On TPU the SSGD step runs the traffic-proportional block-gather Pallas
-kernel (``sampler='fused_gather'``: per step, sample frac·n_blocks block
-ids XLA-side and DMA ONLY those blocks — HBM traffic ≈ fraction × |X|);
-elsewhere it falls back to the XLA Bernoulli-mask path so the bench still
-runs on CPU meshes. Steps are timed over ``N_STEPS``-long jitted scans —
+On TPU the SSGD step runs the whole-schedule megakernel on single-shard
+meshes (``sampler='fused_train'``: weights in VMEM, update in-kernel,
+one Mosaic launch per 125 steps) and the traffic-proportional
+block-gather kernel on dp>1 meshes (``sampler='fused_gather'``: per
+step, sample frac·n_blocks block ids XLA-side and DMA ONLY those blocks
+— HBM traffic ≈ fraction × |X|); elsewhere it falls back to the XLA
+Bernoulli-mask path so the bench still runs on CPU meshes. Steps are timed over ``N_STEPS``-long jitted scans —
 the reference's whole-schedule-in-one-program shape — so per-call
 dispatch overhead (large on tunneled TPU rigs) is amortized exactly the
 way a real training run amortizes it; ``N_CHAIN`` back-to-back async
@@ -156,7 +158,7 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
 
         data = datasets.breast_cancer_split()
         with warnings.catch_warnings():
-            warnings.simplefilter("ignore")  # tiny-geometry quantization
+            warnings.filterwarnings("ignore", message="fused_gather:")
             conv["convergence_acc_fused"] = round(ssgd.train(
                 *data, mesh,
                 ssgd.SSGDConfig(n_iterations=1500, sampler="fused"),
@@ -304,7 +306,7 @@ def _bench_local_sgd(mesh, n_chips, ssgd_per_chip):
     n_rounds, n_local = 300, 5
     cfg = ma.MAConfig(
         n_iterations=n_rounds, n_local_iterations=n_local,
-        eval_test=False, sampler="fused_gather", x_dtype="bfloat16",
+        eval_test=False, sampler="fused_train", x_dtype="bfloat16",
         gather_block_rows=GATHER_BLOCK_ROWS, shuffle_seed=0,
     )
     from tpu_distalg.models import local_sgd
@@ -324,9 +326,9 @@ def _bench_local_sgd(mesh, n_chips, ssgd_per_chip):
 
     data = datasets.breast_cancer_split()
     with warnings.catch_warnings():
-        warnings.simplefilter("ignore")  # tiny-geometry quantization
+        warnings.filterwarnings("ignore", message="fused_gather:")
         conv = ma.train(*data, mesh, ma.MAConfig(
-            n_iterations=300, sampler="fused_gather",
+            n_iterations=300, sampler="fused_train",
             gather_block_rows=64, fused_pack=4, shuffle_seed=0,
         )).final_acc
 
@@ -342,7 +344,7 @@ def _bench_local_sgd(mesh, n_chips, ssgd_per_chip):
         "n_rows": N_ROWS,
         "n_rounds": n_rounds,
         "n_local_iterations": n_local,
-        "convergence_acc_fused_gather": round(conv, 6),
+        "convergence_acc_fused_train": round(conv, 6),
         "spread": spread,
     }), flush=True)
 
@@ -372,7 +374,8 @@ def _bench_kmeans_scale(mesh, n_chips):
     best, spread, (centers, _, _) = profiling.steps_per_sec(
         lambda: fn(ps.data, ps.mask, centers0),
         steps=iters, repeats=N_REPEATS, with_stats=True,
-        with_output=True, chain=2)  # ~3.5 s/call: round-trip < 2%
+        with_output=True, chain=N_CHAIN)  # ~70 ms/call since the
+    #                               one-hot-matmul cluster_stats
 
     # recovery evidence: every true mixture mean found
     got = np.asarray(centers)

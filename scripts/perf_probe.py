@@ -47,7 +47,12 @@ def probe_fused(name, config):
         print(f"{name:30s}       skip (needs TPU)", flush=True)
         return
     X, y = _data()
-    fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh, config)
+    try:
+        fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh, config)
+    except ValueError as e:
+        # e.g. fused_train on a multi-data-shard mesh
+        print(f"{name:30s}       skip ({e})", flush=True)
+        return
     dummy = jnp.zeros((1,), jnp.float32)
     ev = (jnp.zeros((1, meta["d_total"]), jnp.float32),
           jnp.zeros((1,), jnp.float32))

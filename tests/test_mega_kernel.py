@@ -71,6 +71,10 @@ def test_fused_train_validation(mesh8, mesh1, cancer_data):
     with pytest.raises(ValueError, match="segment boundaries"):
         _train_w(cancer_data, mesh1,
                  dataclasses.replace(CFG, eval_test=True, eval_every=1))
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        _train_w(cancer_data, mesh1, CFG,
+                 checkpoint_dir="/tmp/mega_ckpt_invalid",
+                 checkpoint_every=30)  # > mega_steps=20, not a multiple
 
 
 def test_fused_train_bf16_matches_fused_gather_bf16(mesh1, cancer_data):
@@ -109,3 +113,54 @@ def test_fused_train_t0_offset_continuity(mesh1, cancer_data):
     w_half, _ = fn30(X2, dummy, dummy, te[0], te[1], w0, t0=0)
     w_both, _ = fn30(X2, dummy, dummy, te[0], te[1], w_half, t0=30)
     np.testing.assert_array_equal(np.asarray(w_full), np.asarray(w_both))
+
+
+def test_local_sgd_fused_train_matches_fused_gather(mesh4, cancer_data):
+    """The local-update family's megakernel: each round's n_local steps
+    run as ONE launch per replica. Must match the per-step fused path on
+    a 4-replica mesh for all three combine rules (MA/BMUF/EASGD) — this
+    is the dp>1 composition SSGD's megakernel cannot do, plus the
+    in-kernel elastic pull."""
+    from tpu_distalg.models import bmuf, easgd, ma
+
+    for mod, cfg_cls in ((ma, ma.MAConfig), (bmuf, bmuf.BMUFConfig),
+                         (easgd, easgd.EASGDConfig)):
+        # 5 rounds: the paths differ only in f32 reduction order, and
+        # SGD on the unnormalized cancer features amplifies ~1.9x per
+        # round (measured: 2e-7 after 1 round, 4e-5 after 5) — tight
+        # equality is only meaningful over a short horizon
+        base = dict(n_iterations=5, fused_pack=4, gather_block_rows=32,
+                    shuffle_seed=0, eval_test=False)
+        r_mega = mod.train(*cancer_data, mesh4,
+                           cfg_cls(sampler="fused_train", **base))
+        r_step = mod.train(*cancer_data, mesh4,
+                           cfg_cls(sampler="fused_gather", **base))
+        np.testing.assert_allclose(
+            np.asarray(r_mega.w), np.asarray(r_step.w), atol=1e-3,
+            err_msg=f"{mod.__name__} megakernel != per-step")
+        np.testing.assert_allclose(
+            np.asarray(r_mega.ws), np.asarray(r_step.ws), atol=1e-3)
+
+
+def test_local_sgd_fused_train_converges(mesh4, cancer_data):
+    """Full-horizon run: the chaotic divergence from the per-step path
+    stays inside the reference convergence band (ma.py golden 0.8538;
+    the deterministic fused_gather run measures 0.9415)."""
+    from tpu_distalg.models import ma
+
+    res = ma.train(*cancer_data, mesh4, ma.MAConfig(
+        n_iterations=300, sampler="fused_train", fused_pack=4,
+        gather_block_rows=32, shuffle_seed=0))
+    assert res.final_acc > 0.90
+
+
+def test_local_sgd_fused_train_checkpoint_bitwise(mesh4, cancer_data,
+                                                  tmp_path):
+    from tpu_distalg.models import ma
+
+    cfg = ma.MAConfig(n_iterations=30, sampler="fused_train",
+                      fused_pack=4, gather_block_rows=32, shuffle_seed=0)
+    straight = ma.train(*cancer_data, mesh4, cfg).w
+    seg = ma.train(*cancer_data, mesh4, cfg,
+                   checkpoint_dir=str(tmp_path), checkpoint_every=10).w
+    np.testing.assert_array_equal(np.asarray(straight), np.asarray(seg))

@@ -64,7 +64,12 @@ class LocalSGDConfig:
     # rows (reference sample() semantics); 'fused_gather' = the packed
     # traffic-proportional Pallas kernel: each replica's local step DMAs
     # only its sampled gather_block_rows-row blocks (same grad_sum
-    # contract, block-cluster sampling — see ssgd.SSGDConfig.sampler).
+    # contract, block-cluster sampling — see ssgd.SSGDConfig.sampler);
+    # 'fused_train' = 'fused_gather' with each round's n_local steps
+    # fused into ONE megakernel launch per replica (weights in VMEM,
+    # update + elastic pull in-kernel). Unlike SSGD's megakernel this
+    # composes with dp>1 — local steps touch no interconnect; the
+    # round-end pmean is unchanged.
     sampler: str = "bernoulli"
     x_dtype: str = "float32"
     fused_pack: int = 16
@@ -252,22 +257,49 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
         return jnp.broadcast_to(
             idx, (ts.shape[0], L, n_shards, n_sampled))
 
-    def local_rounds(X2, idx_round, ws_local, w):
-        # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's draws
-        w_l = w if config.resync else ws_local[0]
+    if config.sampler == "fused_train":
+        mega_kern = functools.partial(
+            pallas_kernels.fused_train_gathered,
+            pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+            v_col=meta["v_col"],
+            gather_block_rows=config.gather_block_rows,
+            eta=config.eta, alpha=config.elastic_alpha,
+            interpret=not on_tpu,
+        )
 
-        def local_step(w_l, idx_l):
-            g, cnt = kern(X2, w_l, idx_l[0])
-            g_mean = (g * col_keep) / jnp.maximum(cnt, 1.0)
-            w_l = (
-                w_l
-                - config.eta * g_mean
-                - config.elastic_alpha * (w_l - w)  # easgd.py:41-45
+        def local_rounds(X2, idx_round, ws_local, w):
+            # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's
+            # draws. The whole L-step local loop is ONE megakernel
+            # launch: weights live in VMEM, the SGD update and the
+            # elastic pull run in-kernel (fused_train_gathered); the
+            # center is fixed for the round, exactly easgd.py:41-45 /
+            # ma.py:98-102 semantics
+            w_l = w if config.resync else ws_local[0]
+            pk = meta["pack"]
+            wt = mega_kern(
+                X2, jnp.tile(w_l, (pk,))[:, None], idx_round[:, 0, :],
+                center_tile=jnp.tile(w, (pk,))[:, None],
             )
-            return w_l, None
+            w_l = wt[:d_t, 0]
+            return w_l[None, :], tree_allreduce_mean(w_l)
+    else:
+        def local_rounds(X2, idx_round, ws_local, w):
+            # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's
+            # draws
+            w_l = w if config.resync else ws_local[0]
 
-        w_l, _ = jax.lax.scan(local_step, w_l, idx_round)
-        return w_l[None, :], tree_allreduce_mean(w_l)
+            def local_step(w_l, idx_l):
+                g, cnt = kern(X2, w_l, idx_l[0])
+                g_mean = (g * col_keep) / jnp.maximum(cnt, 1.0)
+                w_l = (
+                    w_l
+                    - config.eta * g_mean
+                    - config.elastic_alpha * (w_l - w)  # easgd.py:41-45
+                )
+                return w_l, None
+
+            w_l, _ = jax.lax.scan(local_step, w_l, idx_round)
+            return w_l[None, :], tree_allreduce_mean(w_l)
 
     local_fn = data_parallel(
         local_rounds, mesh,
@@ -422,7 +454,7 @@ def train(
     runs are bitwise-identical because round PRNG keys use absolute
     round ids.
     """
-    if config.sampler == "fused_gather":
+    if config.sampler in ("fused_gather", "fused_train"):
         return _train_fused(
             X_train, y_train, X_test, y_test, mesh, config,
             checkpoint_dir=checkpoint_dir,

@@ -954,6 +954,18 @@ def _train_fused(
         metrics.guard_finite(w, "SSGD (fused) weights")
         return TrainResult(w=w[:d_orig], accs=accs)
 
+    if (config.sampler == "fused_train"
+            and checkpoint_every > config.mega_steps
+            and checkpoint_every % config.mega_steps):
+        # each checkpoint segment re-enters _make_train_fn_mega with
+        # n_iterations=segment length; segments shorter than mega_steps
+        # degrade to one launch, longer ones must hold whole launches
+        raise ValueError(
+            f"checkpoint_every ({checkpoint_every}) must be a multiple "
+            f"of mega_steps ({config.mega_steps}) for "
+            "sampler='fused_train'"
+        )
+
     from tpu_distalg.utils import checkpoint as ckpt
 
     (w, _), accs, _ = ckpt.run_segmented(

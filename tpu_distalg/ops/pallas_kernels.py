@@ -345,10 +345,10 @@ def fused_grad_sum_gathered(X2, w_aug, block_idx, *, pack: int,
 
 
 def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
-                           ew3_ref, eyv_ref, w0_ref, wout_ref,
+                           ew3_ref, eyv_ref, w0_ref, ctr_ref, wout_ref,
                            c_ref, wm_ref, acc_ref, cacc_ref, *,
-                           pack: int, eta: float, n_sampled: int,
-                           sel_dtype):
+                           pack: int, eta: float, alpha: float,
+                           n_sampled: int, sel_dtype):
     """v5 body: T SGD steps in ONE kernel launch (see
     :func:`fused_train_gathered`). Grid (T, n_sampled); the weight
     master ``wm`` (P·D, 1) f32 and the bf16 selector ``c`` live in VMEM
@@ -397,8 +397,13 @@ def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
                         preferred_element_type=jnp.float32)  # (P, D)
         grow = jnp.sum(yband, axis=0, keepdims=True)          # (1, D)
         gcol = jnp.sum(eye_ref[:] * grow, axis=1, keepdims=True)
-        wm_ref[:] = wm_ref[:] - (eta / nb) * jnp.dot(
+        wm = wm_ref[:] - (eta / nb) * jnp.dot(
             s_ref[:], gcol, preferred_element_type=jnp.float32)
+        if alpha:
+            # EASGD elastic pull toward the round-start center
+            # (easgd.py:41-45); both tails are zero, so no column mask
+            wm = wm - alpha * (wm_ref[:] - ctr_ref[:])
+        wm_ref[:] = wm
         c_ref[:] = (
             jnp.broadcast_to(wm_ref[:], c_ref.shape) * ew3_ref[:]
         ).astype(sel_dtype) + eyv_ref[:]
@@ -411,11 +416,12 @@ def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("pack", "d_total", "y_col", "v_col",
-                     "gather_block_rows", "eta", "interpret"),
+                     "gather_block_rows", "eta", "alpha", "interpret"),
 )
 def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
                          d_total: int, y_col: int, v_col: int,
                          gather_block_rows: int, eta: float,
+                         alpha: float = 0.0, center_tile=None,
                          interpret: bool = False):
     """T block-sampled SGD steps in ONE pallas_call (v5, "megakernel").
 
@@ -441,6 +447,13 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
     (``jnp.tile(w_aug, P)[:, None]``). ``block_idx``: (T, n_sampled)
     int32. Returns the final (P·D, 1) weight tile; row j of any slot c
     (``tile[c*D+j, 0]``) is ``w_aug[j]``.
+
+    ``alpha``/``center_tile`` add the EASGD elastic pull
+    ``w −= α·(w − center)`` per step (``easgd.py:41-45``) — the center
+    is fixed for the whole launch, which is exactly a local-SGD round's
+    contract (the local-update family fuses its ``n_local`` steps into
+    one launch per round; valid at dp>1 because local steps touch no
+    interconnect).
     """
     P, D = pack, d_total
     n2, pd = X2.shape
@@ -482,9 +495,11 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
         [jnp.zeros((P * D, P), X2.dtype), ey, ev], axis=1
     ).astype(X2.dtype)  # eyeP is f32; the products promote
 
+    if center_tile is None:
+        center_tile = jnp.zeros((P * D, 1), jnp.float32)
     kernel = functools.partial(
-        _train_kernel_gathered, pack=P, eta=eta, n_sampled=n_sampled,
-        sel_dtype=X2.dtype)
+        _train_kernel_gathered, pack=P, eta=eta, alpha=alpha,
+        n_sampled=n_sampled, sel_dtype=X2.dtype)
     whole = lambda t, i, s: (0, 0)  # noqa: E731 — resident constants
     wout = pl.pallas_call(
         kernel,
@@ -499,6 +514,7 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
                 pl.BlockSpec((P * D, 3 * P), whole),   # Ew3
                 pl.BlockSpec((P * D, 3 * P), whole),   # EyEv
                 pl.BlockSpec((P * D, 1), whole),       # w_tile0
+                pl.BlockSpec((P * D, 1), whole),       # center tile
             ],
             out_specs=pl.BlockSpec((P * D, 1), whole),
             scratch_shapes=[
@@ -515,7 +531,7 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
         ),
         interpret=interpret,
     )(block_idx.astype(jnp.int32), X2, msel, s_tile, eye_d, ew3, eyv,
-      w_tile0)
+      w_tile0, center_tile)
     return wout
 
 
