@@ -122,3 +122,37 @@ def test_fused_gather_gradient_expectation(tpu_mesh):
     gf = np.asarray(g_full / cnt)
     se = float(np.std(X) * 0.5 / np.sqrt(0.1 * n * T))
     np.testing.assert_allclose(gm, gf, atol=20 * se)
+
+
+def test_fused_train_convergence(tpu_mesh, cancer_data):
+    """sampler='fused_train' (whole-schedule megakernel, Mosaic path):
+    reaches the reference band; the trajectory legitimately differs
+    from fused_gather's by f32 reduction order (measured 0.95 here vs
+    0.9298 — both inside the LR/SSGD golden band)."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message="fused_gather:")
+        res = ssgd.train(
+            *cancer_data, tpu_mesh,
+            ssgd.SSGDConfig(n_iterations=1500, sampler="fused_train",
+                            mega_steps=125, eval_every=125,
+                            fused_pack=4, gather_block_rows=32,
+                            shuffle_seed=0),
+        )
+    assert res.final_acc >= 0.92, res.final_acc
+
+
+def test_local_fused_train_convergence(tpu_mesh, cancer_data):
+    """MA with megakernel local rounds on the real Mosaic path."""
+    import warnings
+
+    from tpu_distalg.models import ma
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message="fused_gather:")
+        res = ma.train(*cancer_data, tpu_mesh, ma.MAConfig(
+            n_iterations=300, sampler="fused_train",
+            gather_block_rows=64, fused_pack=4, shuffle_seed=0))
+    # reference MA golden 0.8538 (ma.py:131); measured 0.8947 on TPU
+    assert res.final_acc >= 0.85, res.final_acc

@@ -36,6 +36,17 @@ def _add_common(p, n_iterations, eta=None, frac=None):
         p.add_argument("--eta", type=float, default=eta)
     if frac is not None:
         p.add_argument("--mini-batch-fraction", type=float, default=frac)
+        # TPU perf knobs (see ssgd.SSGDConfig.sampler for semantics;
+        # the local-update family takes bernoulli/fused_gather/
+        # fused_train, SSGD additionally fixed/fused)
+        p.add_argument("--sampler", default="bernoulli",
+                       choices=["bernoulli", "fixed", "fused",
+                                "fused_gather", "fused_train"])
+        p.add_argument("--x-dtype", default="float32",
+                       choices=["float32", "bfloat16"])
+        p.add_argument("--gather-block-rows", type=int, default=1024)
+        p.add_argument("--fused-pack", type=int, default=16)
+        p.add_argument("--shuffle-seed", type=int, default=None)
     p.add_argument("--plot", type=str, default=None,
                    help="save an accuracy plot PNG here")
     p.add_argument("--quiet", action="store_true")
@@ -202,12 +213,21 @@ def _dispatch(args, jax):
         elif args.cmd == "ssgd":
             from tpu_distalg.models import ssgd as m
 
-            res = m.train(*data, mesh, m.SSGDConfig(
+            kw = dict(
                 n_iterations=args.n_iterations, eta=args.eta,
                 mini_batch_fraction=args.mini_batch_fraction,
-                lam=args.lam, reg_type=args.reg_type),
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every)
+                lam=args.lam, reg_type=args.reg_type,
+                sampler=args.sampler, x_dtype=args.x_dtype,
+                gather_block_rows=args.gather_block_rows,
+                fused_pack=args.fused_pack,
+                shuffle_seed=args.shuffle_seed)
+            if args.sampler == "fused_train":
+                # the megakernel evaluates at launch boundaries only
+                kw["eval_every"] = min(m.SSGDConfig().mega_steps,
+                                       args.n_iterations)
+            res = m.train(*data, mesh, m.SSGDConfig(**kw),
+                          checkpoint_dir=args.checkpoint_dir,
+                          checkpoint_every=args.checkpoint_every)
         else:
             mod = {
                 "ma": "MAConfig", "bmuf": "BMUFConfig", "easgd": "EASGDConfig"
@@ -220,7 +240,11 @@ def _dispatch(args, jax):
                 n_iterations=args.n_iterations, eta=args.eta,
                 mini_batch_fraction=args.mini_batch_fraction,
                 n_local_iterations=args.n_local_iterations,
-                resample_per_local_step=args.resample_per_local_step),
+                resample_per_local_step=args.resample_per_local_step,
+                sampler=args.sampler, x_dtype=args.x_dtype,
+                gather_block_rows=args.gather_block_rows,
+                fused_pack=args.fused_pack,
+                shuffle_seed=args.shuffle_seed),
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every)
         jax.block_until_ready(res.w)
