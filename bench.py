@@ -232,9 +232,14 @@ def _bench_ssgd_scale(mesh, n_chips):
 
     n_rows, n_steps, n_features = 100_000_000, 500, 30
     rss_before = peak_rss_gb()
+    # blocks 16x the 1M-row bench's: at this scale the grid is the
+    # overhead (1221 sampled blocks/step at 8192 rows → 0.63 of
+    # roofline; 76 at 131072 → 0.87 measured). Coarser block-cluster
+    # draws are statistically free here — rows come from a
+    # counter-based per-row PRNG, i.i.d. by construction
     cfg = ssgd.SSGDConfig(
         n_iterations=n_steps, eval_test=False, x_dtype="bfloat16",
-        sampler="fused_gather", gather_block_rows=GATHER_BLOCK_ROWS,
+        sampler="fused_gather", gather_block_rows=131072,
         init_seed=7)
     t0 = time.perf_counter()
     fn, X2, w0, meta = ssgd.prepare_fused_synthetic(
@@ -270,6 +275,10 @@ def _bench_ssgd_scale(mesh, n_chips):
     X_ho = jnp.concatenate([X_ho, jnp.ones((n_heldout, 1))], axis=1)
     acc = float(mtr.binary_accuracy(X_ho @ jnp.asarray(w)[:d], y_ho))
 
+    n_shards = int(mesh.shape["data"])
+    _, n_sampled = ssgd.fused_gather_geometry(cfg, meta, n_shards)
+    bytes_per_step = (n_sampled * n_shards * cfg.gather_block_rows
+                      * int(meta["d_total"]) * 2)
     print(json.dumps({
         "metric": "ssgd_lr_100m_rows_steps_per_sec_per_chip",
         "value": round(best / n_chips, 2),
@@ -278,6 +287,9 @@ def _bench_ssgd_scale(mesh, n_chips):
         "n_rows": n_rows,
         "n_features": n_features,
         "data_path": "on-device per-shard synthesis (host RAM O(1))",
+        "hbm_peak_fraction": round(
+            bytes_per_step * best
+            / (n_shards * V5E_HBM_BYTES_PER_SEC), 4),
         "hbm_bytes_dataset": int(X2.size) * 2,
         "generation_seconds": round(gen_seconds, 1),
         # host memory the 8 GB dataset cost: ~0 (synthesized on device);
@@ -467,6 +479,90 @@ def _bench_pagerank(mesh, n_chips):
     }), flush=True)
 
 
+def _bench_als(mesh, n_chips):
+    """ALS at a scale the reference's broadcast-everything design cannot
+    reach: it re-broadcasts the FULL dense R, U, V to every task each
+    half-sweep (``matrix_decomposition.py:46-48``) — at 4096×16384 that
+    is ~256 MB per task per half-sweep over TCP. Here R stays resident
+    in HBM, solves are batched Cholesky on the MXU, and V shards over
+    the model axis when one exists."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_distalg.models import als
+    from tpu_distalg.utils import profiling, prng
+
+    m, n, k, sweeps = 4096, 16384, 64, 10
+    cfg = als.ALSConfig(m=m, n=n, k=k, lam=0.0, n_iterations=sweeps)
+    key = prng.root_key(cfg.seed)
+    U0 = jax.random.normal(jax.random.fold_in(key, 0), (m, k)) * 0.3
+    V0 = jax.random.normal(jax.random.fold_in(key, 1), (n, k)) * 0.3
+    R = U0 @ V0.T  # exactly rank-k, as the reference synthesizes (:42)
+    Ui = jax.random.normal(jax.random.fold_in(key, 2), (m, k)) * 0.1
+    Vi = jax.random.normal(jax.random.fold_in(key, 3), (n, k)) * 0.1
+    fn = als.make_fit_fn(mesh, cfg)
+    best, spread, (_, _, errs) = profiling.steps_per_sec(
+        lambda: fn(R, Ui, Vi), steps=sweeps, with_stats=True,
+        with_output=True, repeats=N_REPEATS, chain=4)
+
+    print(json.dumps({
+        "metric": "als_4kx16k_sweeps_per_sec_per_chip",
+        "value": round(best / n_chips, 3),
+        "unit": "sweeps/s/chip",
+        "vs_baseline": None,
+        "m": m, "n": n, "k": k,
+        "final_rmse": round(float(jnp.asarray(errs)[-1]), 6),
+        "spread": spread,
+    }), flush=True)
+
+
+def _bench_ring_attention(mesh, n_chips):
+    """Long-context headroom evidence on real hardware: 32k-token
+    causal multi-head attention through the ring/online-softmax path
+    with flash-style kv chunking (SURVEY.md §5 charter; the reference
+    has no attention). On one chip the ring is a single hop — the
+    multi-chip collective path is exercised on the CPU mesh
+    (tests/test_ring.py) and in the multichip dryrun."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_distalg.parallel import DATA_AXIS, data_parallel
+    from tpu_distalg.parallel.ring import ring_attention
+    from tpu_distalg.utils import profiling, prng
+
+    S, H, d, chunk = 32768, 8, 128, 1024
+    key = prng.root_key(0)
+    q, kk, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (S, H, d),
+                          jnp.bfloat16)
+        for i in range(3)
+    )
+    fn = jax.jit(data_parallel(
+        functools.partial(ring_attention, causal=True, kv_chunk=chunk),
+        mesh,
+        in_specs=(P(DATA_AXIS, None, None),) * 3,
+        out_specs=P(DATA_AXIS, None, None),
+    ))
+    best, spread = profiling.steps_per_sec(
+        lambda: fn(q, kk, v), steps=1, with_stats=True,
+        repeats=N_REPEATS, chain=4)
+    # causal flops: S^2/2 keys per query on average, 2 matmuls, 2 FLOP/MAC
+    flops = S * S / 2 * d * H * 2 * 2
+    print(json.dumps({
+        "metric": "ring_attention_32k_tokens_per_sec_per_chip",
+        "value": round(S * best / n_chips, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "seq_len": S, "heads": H, "head_dim": d, "kv_chunk": chunk,
+        "causal": True,
+        "achieved_tflops": round(flops * best / n_chips / 1e12, 2),
+        "spread": spread,
+    }), flush=True)
+
+
 def main(argv=None):
     import argparse
 
@@ -494,6 +590,9 @@ def main(argv=None):
             _bench_local_sgd(mesh, n_chips, ssgd_per_chip)
             _bench_kmeans_scale(mesh, n_chips)
         _bench_pagerank(mesh, n_chips)
+        if on_tpu:
+            _bench_als(mesh, n_chips)
+            _bench_ring_attention(mesh, n_chips)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,115 @@
+"""Single-pass Pallas k-means: the fused stats kernel and the fused fit
+loop must match the XLA path (interpret mode on CPU — the Mosaic path is
+the same code)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_distalg.models import kmeans
+from tpu_distalg.ops import kmeans as kops
+from tpu_distalg.ops import pallas_kmeans as pk
+from tpu_distalg.parallel import parallelize
+
+
+def _bf16_grid_assign(pts, centers):
+    """The kernel's documented assignment contract: squared distances
+    via the f32 expansion, compared on the bf16 grid, first-minimum
+    tie-break."""
+    p, c = jnp.asarray(pts), jnp.asarray(centers)
+    d2 = (jnp.sum(p * p, axis=1, keepdims=True)
+          - 2.0 * jnp.einsum("nd,kd->nk", p, c)
+          + jnp.sum(c * c, axis=1)[None, :])
+    d2 = d2.astype(jnp.bfloat16).astype(jnp.float32)
+    return jnp.argmin(d2, axis=1)  # argmin takes the first minimum
+
+
+def test_fused_stats_matches_xla():
+    rng = np.random.default_rng(0)
+    for (n, dim, k) in ((8192, 16, 8), (777, 11, 5), (5000, 2, 2),
+                        (3000, 64, 3)):
+        pts = (rng.normal(size=(n, dim)) * 3).astype(np.float32)
+        mask = np.ones(n, np.float32)
+        mask[-n // 10:] = 0.0
+        centers = (rng.normal(size=(k, dim)) * 3).astype(np.float32)
+        X2, m2 = pk.pack_points(pts, mask, dim=dim, k=k)
+        sums, counts = pk.fused_cluster_stats(
+            X2, m2, jnp.asarray(centers), dim=dim, k=k, interpret=True)
+        assign = _bf16_grid_assign(pts, centers)
+        s_ref, c_ref = kops.cluster_stats(
+            jnp.asarray(pts), jnp.asarray(mask), assign, k)
+        np.testing.assert_allclose(np.asarray(counts), np.asarray(c_ref),
+                                   err_msg=f"{(n, dim, k)}")
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(s_ref),
+                                   rtol=1e-5, atol=1e-4)
+        # on well-separated data (margins >> bf16 eps) the bf16-grid
+        # contract coincides with exact f32 assignment
+        a_f32 = np.asarray(kops.assign_clusters(
+            jnp.asarray(pts), jnp.asarray(centers)))
+        frac_same = (np.asarray(assign) == a_f32).mean()
+        assert frac_same > 0.98, (n, dim, k, frac_same)
+
+
+def test_fused_stats_tie_break_first_min():
+    """Duplicate centers: the argmin must pick the FIRST minimum, like
+    the reference's strict-< scan (k-means.py:20-28)."""
+    pts = np.array([[1.0, 1.0], [5.0, 5.0]], np.float32)
+    centers = np.array([[1.0, 1.0], [1.0, 1.0], [5.0, 5.0]], np.float32)
+    X2, m2 = pk.pack_points(pts, np.ones(2, np.float32), dim=2, k=3)
+    _, counts = pk.fused_cluster_stats(
+        X2, m2, jnp.asarray(centers), dim=2, k=3, interpret=True)
+    np.testing.assert_array_equal(np.asarray(counts), [1.0, 0.0, 1.0])
+
+
+def test_fused_fit_matches_xla_fit(mesh8):
+    rng = np.random.default_rng(1)
+    n, dim, k = 4096, 8, 4
+    pts = np.concatenate([
+        rng.normal(size=(n // k, dim)).astype(np.float32) + 8.0 * c
+        for c in range(k)
+    ])
+    cfg = kmeans.KMeansConfig(k=k, n_iterations=6, seed=3)
+    c0 = kmeans.init_centers(pts, k, cfg.seed)
+
+    ps = parallelize(pts, mesh8)
+    centers_ref, _, _ = kmeans.make_fit_fn(mesh8, cfg)(
+        ps.data, ps.mask, c0)
+
+    X2, m2 = kmeans.pack_device(mesh8, ps.data, ps.mask, dim=dim, k=k,
+                                block_rows=64)
+    fit = kmeans.make_fit_fn_fused(mesh8, cfg, dim, block_rows=64)
+    centers_fused, assign, n_run = fit(X2, m2, c0)
+    assert int(n_run) == 6
+    # bf16-grid assignment flips rare boundary points vs the exact-f32
+    # XLA path; over 6 Lloyd iterations that perturbs the means slightly
+    # — both runs land on the same clustering
+    np.testing.assert_allclose(
+        np.asarray(centers_fused), np.asarray(centers_ref), atol=0.05)
+    # final assignments agree on the real rows (per-shard packing pads
+    # interleave in the global order — select by the packed mask, which
+    # preserves the shard-contiguous original row order)
+    a_ref = np.asarray(kops.assign_clusters(
+        jnp.asarray(pts), centers_ref))
+    m_flat = np.asarray(m2).reshape(-1) > 0
+    agree = (np.asarray(assign)[m_flat] == a_ref).mean()
+    assert agree > 0.995, agree
+
+
+def test_fused_fit_converge_mode(mesh8):
+    rng = np.random.default_rng(2)
+    n, dim, k = 2048, 4, 2
+    pts = np.concatenate([
+        rng.normal(size=(n // 2, dim)).astype(np.float32),
+        rng.normal(size=(n // 2, dim)).astype(np.float32) + 20.0,
+    ])
+    cfg = kmeans.KMeansConfig(k=k, converge_dist=1e-3, seed=0,
+                              max_iterations=50)
+    c0 = kmeans.init_centers(pts, k, cfg.seed)
+    ps = parallelize(pts, mesh8)
+    X2, m2 = kmeans.pack_device(mesh8, ps.data, ps.mask, dim=dim, k=k,
+                                block_rows=32)
+    centers, _, n_run = kmeans.make_fit_fn_fused(
+        mesh8, cfg, dim, block_rows=32)(X2, m2, c0)
+    assert 0 < int(n_run) < 50
+    got = np.asarray(centers)[np.argsort(np.asarray(centers)[:, 0])]
+    np.testing.assert_allclose(got[0], pts[:n // 2].mean(0), atol=0.1)
+    np.testing.assert_allclose(got[1], pts[n // 2:].mean(0), atol=0.1)

@@ -28,7 +28,7 @@ def _mesh(args):
     ).mesh
 
 
-def _add_common(p, n_iterations, eta=None, frac=None):
+def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     p.add_argument("--n-slices", type=int, default=0,
                    help="data-axis size; 0 = all devices")
     p.add_argument("--n-iterations", type=int, default=n_iterations)
@@ -36,12 +36,11 @@ def _add_common(p, n_iterations, eta=None, frac=None):
         p.add_argument("--eta", type=float, default=eta)
     if frac is not None:
         p.add_argument("--mini-batch-fraction", type=float, default=frac)
-        # TPU perf knobs (see ssgd.SSGDConfig.sampler for semantics;
-        # the local-update family takes bernoulli/fused_gather/
-        # fused_train, SSGD additionally fixed/fused)
+        # TPU perf knobs (see ssgd.SSGDConfig.sampler for semantics);
+        # each subcommand advertises only the samplers its training
+        # path accepts
         p.add_argument("--sampler", default="bernoulli",
-                       choices=["bernoulli", "fixed", "fused",
-                                "fused_gather", "fused_train"])
+                       choices=samplers)
         p.add_argument("--x-dtype", default="float32",
                        choices=["float32", "bfloat16"])
         p.add_argument("--gather-block-rows", type=int, default=1024)
@@ -97,14 +96,19 @@ def main(argv=None):
     _add_common(p, 1500, eta=0.1)
 
     p = sub.add_parser("ssgd", help="synchronous minibatch SGD")
-    _add_common(p, 1500, eta=0.1, frac=0.1)
+    _add_common(p, 1500, eta=0.1, frac=0.1,
+                samplers=["bernoulli", "fixed", "fused", "fused_gather",
+                          "fused_train"])
     p.add_argument("--lam", type=float, default=0.0)
     p.add_argument("--reg-type", default="l2",
                    choices=["none", "l2", "l1", "elastic_net"])
 
     for name in ("ma", "bmuf", "easgd"):
         p = sub.add_parser(name)
-        _add_common(p, 1500 if name == "easgd" else 300, eta=0.1, frac=0.1)
+        _add_common(p, 1500 if name == "easgd" else 300, eta=0.1,
+                    frac=0.1,
+                    samplers=["bernoulli", "fused_gather",
+                              "fused_train"])
         p.add_argument("--n-local-iterations", type=int,
                        default=1 if name == "easgd" else 5)
         p.add_argument("--resample-per-local-step", action="store_true")

@@ -61,6 +61,35 @@ def init_centers(points: np.ndarray, k: int, seed: int) -> np.ndarray:
     return np.asarray(points)[idx].astype(np.float32)
 
 
+def _lloyd_loop(one_iter, config: KMeansConfig, centers0):
+    """Shared Lloyd driver: fixed iterations (reference parity) or the
+    real ``converge_dist`` check; ``one_iter(centers) -> centers``.
+    Returns (final centers, iterations run)."""
+    if config.converge_dist is None:
+        centers, _ = jax.lax.scan(
+            lambda c, _: (one_iter(c), None), centers0, None,
+            length=config.n_iterations,
+        )
+        return centers, config.n_iterations
+
+    def cond(state):
+        _, shift, it = state
+        return (shift > config.converge_dist) & (
+            it < config.max_iterations
+        )
+
+    def body(state):
+        centers, _, it = state
+        new = one_iter(centers)
+        shift = jnp.sum(jnp.sqrt(jnp.sum((new - centers) ** 2, axis=1)))
+        return new, shift, it + 1
+
+    centers, _, n_run = jax.lax.while_loop(
+        cond, body, (centers0, jnp.float32(jnp.inf), 0)
+    )
+    return centers, n_run
+
+
 def make_fit_fn(mesh: Mesh, config: KMeansConfig):
     stats_fn = data_parallel(
         _local_stats,
@@ -69,41 +98,90 @@ def make_fit_fn(mesh: Mesh, config: KMeansConfig):
         out_specs=(P(), P(), P("data")),
     )
 
-    def one_iter(points, mask, centers):
-        sums, counts, assign = stats_fn(points, mask, centers)
-        return kops.update_centers(sums, counts, centers), assign
-
     def fit(points, mask, centers0):
-        if config.converge_dist is None:
-            def body(centers, _):
-                centers, _assign = one_iter(points, mask, centers)
-                return centers, None
+        def one_iter(centers):
+            sums, counts, _assign = stats_fn(points, mask, centers)
+            return kops.update_centers(sums, counts, centers)
 
-            centers, _ = jax.lax.scan(
-                body, centers0, None, length=config.n_iterations
-            )
-            n_run = config.n_iterations
-        else:
-            def cond(state):
-                _, shift, it = state
-                return (shift > config.converge_dist) & (
-                    it < config.max_iterations
-                )
-
-            def body(state):
-                centers, _, it = state
-                new, _assign = one_iter(points, mask, centers)
-                shift = jnp.sum(
-                    jnp.sqrt(jnp.sum((new - centers) ** 2, axis=1))
-                )
-                return new, shift, it + 1
-
-            centers, _, n_run = jax.lax.while_loop(
-                cond, body, (centers0, jnp.float32(jnp.inf), 0)
-            )
+        centers, n_run = _lloyd_loop(one_iter, config, centers0)
         # final assignment under the final centers (the reference's closing
         # display re-evaluates with updated centers, k-means.py:57-58,76)
         _, _, assign = stats_fn(points, mask, centers)
+        return centers, assign, n_run
+
+    return jax.jit(fit)
+
+
+def pack_device(mesh: Mesh, points, mask, *, dim: int, k: int,
+                block_rows: int = 4096):
+    """Device-side re-layout of sharded (n, dim) points into the fused
+    kernel's packed rows (``ops.pallas_kmeans.pack_points`` semantics,
+    but each shard packs its own slice — no host materialization, so it
+    composes with ``build_sharded``'s O(1)-host scale path). Appended
+    padding rows carry mask 0 and are inert."""
+    from tpu_distalg.ops import pallas_kmeans as pk
+
+    dpad, pp, _ = pk.packed_geometry(dim, k)
+
+    def body(p, m):
+        n_l = p.shape[0]
+        pad = (-n_l) % pp  # ragged tail rows pad with mask 0, like the
+        #                    host-side pack_points
+        p = jnp.pad(p, ((0, pad), (0, dpad - dim)))
+        m = jnp.pad(m, ((0, pad),))
+        n2 = (n_l + pad) // pp
+        n2p = n2 + (-n2) % block_rows
+        X2 = p.reshape(n2, pp * dpad)
+        return (jnp.pad(X2, ((0, n2p - n2), (0, 0))),
+                jnp.pad(m.reshape(n2, pp), ((0, n2p - n2), (0, 0))))
+
+    f = data_parallel(
+        body, mesh,
+        in_specs=(P("data", None), P("data")),
+        out_specs=(P("data", None), P("data", None)),
+    )
+    return jax.jit(f)(points, mask)
+
+
+def make_fit_fn_fused(mesh: Mesh, config: KMeansConfig, dim: int, *,
+                      block_rows: int = 4096):
+    """Lloyd iterations through the single-pass Pallas kernel
+    (``ops.pallas_kmeans.fused_cluster_stats``): one HBM pass per
+    iteration. NOTE: measured SLOWER than :func:`make_fit_fn` at bench
+    scale (0.64× — see the ``ops/pallas_kmeans`` module docstring for
+    the recorded A/B); kept as a tested alternative, not the default.
+    Call with :func:`pack_device` outputs. Centers and
+    n_iterations_run match :func:`make_fit_fn`; ASSIGNMENTS are in
+    PACKED order with per-shard padding rows interleaved — filter by
+    the flattened packed mask (``mask2.reshape(-1) > 0``) to recover
+    the shard-contiguous input-row order."""
+    from tpu_distalg.ops import pallas_kmeans as pk
+
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    dpad, pp, _ = pk.packed_geometry(dim, config.k)
+
+    def _local_stats2(X2, m2, centers):
+        sums, counts = pk.fused_cluster_stats(
+            X2, m2, centers, dim=dim, k=config.k,
+            block_rows=block_rows, interpret=not on_tpu)
+        return tree_allreduce_sum((sums, counts))
+
+    stats_fn = data_parallel(
+        _local_stats2, mesh,
+        in_specs=(P("data", None), P("data", None), P()),
+        out_specs=(P(), P()),
+    )
+
+    def fit(X2, m2, centers0):
+        def one_iter(centers):
+            sums, counts = stats_fn(X2, m2, centers)
+            return kops.update_centers(sums, counts, centers)
+
+        centers, n_run = _lloyd_loop(one_iter, config, centers0)
+        # final assignment from the packed view (free reshape) under the
+        # final centers — reference display parity (k-means.py:57-58,76)
+        pts = X2.reshape(-1, dpad)[:, :dim]
+        assign = kops.assign_clusters(pts, centers)
         return centers, assign, n_run
 
     return jax.jit(fit)
