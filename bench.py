@@ -65,6 +65,15 @@ V5E_HBM_BYTES_PER_SEC = 819e9
 WATCHDOG_SECONDS = int(os.environ.get("BENCH_WATCHDOG_SECONDS", 1800))
 
 
+def _hbm_fraction(bytes_per_step, steps_per_sec, n_shards):
+    """Per-chip fraction of the HBM roofline: per-chip bytes (global
+    bytes_per_step / n_shards) × the TOTAL step rate — correct on
+    (data, model>1) meshes too, where chip count != data-shard count."""
+    return round(
+        bytes_per_step * steps_per_sec
+        / (n_shards * V5E_HBM_BYTES_PER_SEC), 4)
+
+
 def _watchdog():
     """If the device never comes up (e.g. a wedged TPU tunnel), emit an
     honest zero-value metric line instead of hanging the harness forever."""
@@ -193,12 +202,8 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         "n_features": N_FEATURES,
         "steps_per_segment": N_STEPS,
         "bytes_per_step": bytes_per_step,
-        # per-chip fraction: per-chip bytes (global bytes_per_step /
-        # n_shards) × the TOTAL step rate — correct on (data, model>1)
-        # meshes too, where n_chips != n_shards
-        "hbm_peak_fraction": round(
-            bytes_per_step * best
-            / (n_shards * V5E_HBM_BYTES_PER_SEC), 4),
+        "hbm_peak_fraction": _hbm_fraction(bytes_per_step, best,
+                                           n_shards),
         "baseline_steps_per_sec_measured": round(measured_baseline, 2),
         "baseline_method": (
             "jit-per-step host-roundtrip loop (measured); "
@@ -287,9 +292,8 @@ def _bench_ssgd_scale(mesh, n_chips):
         "n_rows": n_rows,
         "n_features": n_features,
         "data_path": "on-device per-shard synthesis (host RAM O(1))",
-        "hbm_peak_fraction": round(
-            bytes_per_step * best
-            / (n_shards * V5E_HBM_BYTES_PER_SEC), 4),
+        "hbm_peak_fraction": _hbm_fraction(bytes_per_step, best,
+                                           n_shards),
         "hbm_bytes_dataset": int(X2.size) * 2,
         "generation_seconds": round(gen_seconds, 1),
         # host memory the 8 GB dataset cost: ~0 (synthesized on device);

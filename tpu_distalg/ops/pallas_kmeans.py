@@ -64,6 +64,11 @@ def packed_geometry(dim: int, k: int):
     if dpad > 128:
         raise ValueError(f"pallas k-means supports dim <= 128, got {dim}")
     pp = 128 // dpad
+    if k > 256:
+        # class ids travel through bf16 permutation matmuls in the
+        # butterfly argmin; integers above 256 are not bf16-exact, which
+        # would silently corrupt the tie-break and the one-hot
+        raise ValueError(f"pallas k-means supports k <= 256, got {k}")
     k_pad = 1
     while k_pad < k:
         k_pad *= 2

@@ -28,6 +28,8 @@ sequence-sharded operands.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -242,9 +244,7 @@ def ulysses_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     return alltoall_head_to_seq(o, axis_name)
 
 
-def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
-    """DeepSpeed-Ulysses-style exchange: (S_local, H, d) sequence-sharded →
-    (S, H_local, d) head-sharded, in one all_to_all over the axis."""
+def _seq_to_head_impl(x, axis_name):
     n = lax.axis_size(axis_name)
     s_l, h, d = x.shape
     if h % n:
@@ -258,10 +258,7 @@ def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
     return out.reshape(n * s_l, h // n, d)
 
 
-def alltoall_head_to_seq(x, axis_name: str = DATA_AXIS):
-    """Inverse of :func:`alltoall_seq_to_head`: (S, H_local, d)
-    head-sharded → (S_local, H, d) sequence-sharded, in one all_to_all.
-    ``alltoall_head_to_seq(alltoall_seq_to_head(x))`` is the identity."""
+def _head_to_seq_impl(x, axis_name):
     n = lax.axis_size(axis_name)
     s, h_l, d = x.shape
     if s % n:
@@ -273,3 +270,35 @@ def alltoall_head_to_seq(x, axis_name: str = DATA_AXIS):
     out = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
                          tiled=False)
     return out.reshape(s // n, n * h_l, d)
+
+
+# Both exchanges are global orthogonal permutations, so each one's VJP
+# is exactly the inverse exchange — declared via custom_vjp because the
+# automatic transpose of all_to_all(tiled=False) through the enclosing
+# reshapes currently fails Mosaic/XLA verification under shard_map.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def alltoall_seq_to_head(x, axis_name: str = DATA_AXIS):
+    """DeepSpeed-Ulysses-style exchange: (S_local, H, d) sequence-sharded →
+    (S, H_local, d) head-sharded, in one all_to_all over the axis."""
+    return _seq_to_head_impl(x, axis_name)
+
+
+alltoall_seq_to_head.defvjp(
+    lambda x, axis_name: (_seq_to_head_impl(x, axis_name), None),
+    lambda axis_name, _, g: (_head_to_seq_impl(g, axis_name),),
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def alltoall_head_to_seq(x, axis_name: str = DATA_AXIS):
+    """Inverse of :func:`alltoall_seq_to_head`: (S, H_local, d)
+    head-sharded → (S_local, H, d) sequence-sharded, in one all_to_all.
+    ``alltoall_head_to_seq(alltoall_seq_to_head(x))`` is the identity."""
+    return _head_to_seq_impl(x, axis_name)
+
+
+alltoall_head_to_seq.defvjp(
+    lambda x, axis_name: (_head_to_seq_impl(x, axis_name), None),
+    lambda axis_name, _, g: (_seq_to_head_impl(g, axis_name),),
+)
