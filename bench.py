@@ -522,11 +522,13 @@ def _bench_als(mesh, n_chips):
 
 def _bench_ring_attention(mesh, n_chips):
     """Long-context headroom evidence on real hardware: 32k-token
-    causal multi-head attention through the ring/online-softmax path
-    with flash-style kv chunking (SURVEY.md §5 charter; the reference
-    has no attention). On one chip the ring is a single hop — the
-    multi-chip collective path is exercised on the CPU mesh
-    (tests/test_ring.py) and in the multichip dryrun."""
+    causal multi-head attention through the ring path with the Pallas
+    flash kernel (whole QKT->softmax->V pipeline per VMEM-resident
+    tile, causal tile skipping; ~4x the XLA online-softmax path —
+    SURVEY.md §5 charter; the reference has no attention). On one chip
+    the ring is a single hop — the multi-chip collective path is
+    exercised on the CPU mesh (tests/test_ring.py) and in the
+    multichip dryrun."""
     import functools
 
     import jax
@@ -537,7 +539,7 @@ def _bench_ring_attention(mesh, n_chips):
     from tpu_distalg.parallel.ring import ring_attention
     from tpu_distalg.utils import profiling, prng
 
-    S, H, d, chunk = 32768, 8, 128, 1024
+    S, H, d = 32768, 8, 128
     key = prng.root_key(0)
     q, kk, v = (
         jax.random.normal(jax.random.fold_in(key, i), (S, H, d),
@@ -545,7 +547,7 @@ def _bench_ring_attention(mesh, n_chips):
         for i in range(3)
     )
     fn = jax.jit(data_parallel(
-        functools.partial(ring_attention, causal=True, kv_chunk=chunk),
+        functools.partial(ring_attention, causal=True, use_flash=True),
         mesh,
         in_specs=(P(DATA_AXIS, None, None),) * 3,
         out_specs=P(DATA_AXIS, None, None),
@@ -560,7 +562,7 @@ def _bench_ring_attention(mesh, n_chips):
         "value": round(S * best / n_chips, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": None,
-        "seq_len": S, "heads": H, "head_dim": d, "kv_chunk": chunk,
+        "seq_len": S, "heads": H, "head_dim": d, "kernel": "flash",
         "causal": True,
         "achieved_tflops": round(flops * best / n_chips / 1e12, 2),
         "spread": spread,

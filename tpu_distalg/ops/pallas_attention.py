@@ -1,0 +1,163 @@
+"""Flash-attention Pallas kernel for the ring/sequence-parallel path.
+
+The XLA online-softmax update (``parallel/ring._online_update``)
+materialises each (S_q, kv_chunk) score tile in HBM between the two
+matmuls and runs the exp/max/rescale chain through XLA fusions —
+measured ~13 TFLOP/s at 32k tokens. Here the whole
+QKᵀ → mask → online-softmax → ·V pipeline runs per (q-block, kv-block)
+tile while it is VMEM-resident (the standard flash-attention
+formulation: Dao et al.; Rabe-Staats chunked softmax), with the MXU
+doing both matmuls back-to-back.
+
+The kernel CARRIES the online-softmax state (o, m, l) in and out, so
+it slots directly into ring attention: each arriving K/V block is one
+kernel call that continues the accumulation, and the final ``o / l``
+normalisation happens once at the end of the ring — numerics identical
+to the XLA path (same update algebra, same f32 accumulation).
+
+Causality is positional: ``q_off``/``k_off`` give the global positions
+of the local Q rows and the resident K/V block (they change as blocks
+rotate around the ring), passed as scalar-prefetch operands so one
+compiled kernel serves every ring step. Masked logits use a finite
+-1e30 sentinel (±inf breeds NaNs through 0·inf in rescales); a guard
+keeps fully-masked tiles from contributing exp(0) mass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _kernel(off_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
+            o_ref, m_ref, l_ref, oacc, macc, lacc, *,
+            scale: float, causal: bool, bq: int, bkv: int):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _load_carry():
+        oacc[:] = o0_ref[0]
+        macc[:] = m0_ref[0]
+        lacc[:] = l0_ref[0]
+
+    i = pl.program_id(1)
+
+    def _tile():
+        q = q_ref[0]                                    # (Bq, d)
+        k = k_ref[0]                                    # (Bkv, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # (Bq, Bkv)
+        if causal:
+            qpos = (off_ref[0] + i * bq
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0))
+            kpos = (off_ref[1] + j * bkv
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1))
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(macc[:], jnp.max(s, axis=1, keepdims=True))
+        # guard: while a row has seen no unmasked key, m_new sits at the
+        # sentinel (or the -inf carry) — its alpha/p must be 0, not
+        # exp(0)
+        live = m_new > _NEG / 2
+        alpha = jnp.where(live, jnp.exp(macc[:] - m_new), 0.0)
+        p = jnp.where(live, jnp.exp(s - m_new), 0.0)    # (Bq, Bkv)
+        lacc[:] = lacc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        oacc[:] = oacc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        macc[:] = m_new
+
+    if causal:
+        # skip fully-masked tiles outright (the strictly-upper-diagonal
+        # half of the grid): a masked tile's update is a provable no-op
+        # (alpha = 1, p = 0), so skipping is exact and saves ~2× FLOPs
+        pl.when(off_ref[0] + (i + 1) * bq - 1
+                >= off_ref[1] + j * bkv)(_tile)
+    else:
+        _tile()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _store():
+        o_ref[0] = oacc[:]
+        m_ref[0] = macc[:]
+        l_ref[0] = lacc[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "causal", "bq", "bkv", "interpret"),
+)
+def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
+                          scale: float, causal: bool = False,
+                          bq: int = 2048, bkv: int = 2048,
+                          interpret: bool = False):
+    """One resident K/V block folded into the online-softmax state.
+
+    ``q``: (H, S_q, d); ``k``, ``v``: (H, S_kv, d); state ``o``:
+    (H, S_q, d) f32, ``m``, ``l``: (H, S_q, 1) f32 (``m`` starts at
+    -inf, ``l``/``o`` at 0). ``q_off``/``k_off``: global positions of
+    row 0 (traced scalars — the ring rotates ``k_off`` per step).
+    Returns the updated (o, m, l); normalise ``o / l`` after the LAST
+    block. Requires d a lane-tile multiple and S_q % bq == S_kv % bkv
+    == 0 — unsupported shapes raise at trace time (use the XLA path,
+    ``ring_attention(use_flash=False)``, for them).
+    """
+    h, s_q, d = q.shape
+    s_kv = k.shape[1]
+    bq = min(bq, s_q)
+    bkv = min(bkv, s_kv)
+    if d % 128 or s_q % bq or s_kv % bkv or bq % 8 or bkv % 128:
+        raise ValueError(
+            f"flash_attention_block: shapes q={q.shape} k={k.shape} "
+            f"need d%128==0 and divisible blocks (bq={bq}, bkv={bkv})"
+        )
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal, bq=bq, bkv=bkv)
+    grid = (h, s_q // bq, s_kv // bkv)
+    qs = lambda hh, i, j, s: (hh, i, 0)    # noqa: E731
+    ks = lambda hh, i, j, s: (hh, j, 0)    # noqa: E731
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, d), qs),
+                pl.BlockSpec((1, bkv, d), ks),
+                pl.BlockSpec((1, bkv, d), ks),
+                pl.BlockSpec((1, bq, d), qs),
+                pl.BlockSpec((1, bq, 1), qs),
+                pl.BlockSpec((1, bq, 1), qs),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, d), qs),
+                pl.BlockSpec((1, bq, 1), qs),
+                pl.BlockSpec((1, bq, 1), qs),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(offs, q, k, v, o, m, l)

@@ -97,7 +97,11 @@ def _online_update(qh, o, m, l, kh, vh, scale, mask):
 def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
                    scale: float | None = None,
                    kv_chunk: int | None = None,
-                   causal: bool = False):
+                   causal: bool = False,
+                   use_flash: bool = False,
+                   flash_interpret: bool = False,
+                   flash_block_q: int = 2048,
+                   flash_block_kv: int = 2048):
     """Exact attention over a sequence sharded around the ring.
 
     ``q, k, v``: (S_local, d) single-head or (S_local, H, d) multi-head
@@ -124,6 +128,14 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     S_local = 32k a full score block is 4 GB and out of HBM, while
     kv_chunk = 1024 keeps it at 128 MB. ``None`` processes whole blocks
     (fine for short sequences; fewer, larger MXU calls).
+
+    ``use_flash=True`` swaps the XLA update for the Pallas flash kernel
+    (``ops.pallas_attention.flash_attention_block``): the whole
+    QKᵀ→softmax→·V pipeline runs per VMEM-resident tile — same algebra
+    and f32 accumulation, much less HBM traffic. Forward-only (no VJP;
+    use the XLA path for training), needs head-dim a multiple of 128
+    and block-divisible lengths, supersedes ``kv_chunk``. Set
+    ``flash_interpret=True`` on CPU meshes (tests).
     """
     single = q.ndim == 2
     if single:
@@ -134,44 +146,60 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     qh = jnp.moveaxis(q, 1, 0)                     # (H, Sq, d)
     s_local = k.shape[0]
-    if kv_chunk is not None and (
+    if not use_flash and kv_chunk is not None and (
         kv_chunk < 1 or (kv_chunk < s_local and s_local % kv_chunk)
     ):
         # kv_chunk >= s_local harmlessly degrades to whole-block
-        # processing (the tile bound is already satisfied)
+        # processing (the tile bound is already satisfied); the flash
+        # kernel tiles internally and never reads kv_chunk
         raise ValueError(
             f"kv_chunk={kv_chunk} must be >= 1 and divide the local "
             f"K/V length {s_local}"
         )
     q_pos = my * s_q + jnp.arange(s_q)             # global query positions
 
-    def process_block(kh, vh, o, m, l, src):
-        # kh, vh: (H, S_local, d) — transposed ONCE before the ring loop;
-        # ppermute commutes with the transpose, so blocks rotate in this
-        # layout and no per-ring-step relayout is paid
-        if kv_chunk is None or kv_chunk >= s_local:
-            mask = None
-            if causal:
-                k_pos = src * s_local + jnp.arange(s_local)
-                mask = q_pos[:, None] >= k_pos[None, :]
-            return _online_update(qh, o, m, l, kh, vh, s, mask)
-        n_chunks = s_local // kv_chunk
-        kc = kh.reshape(h, n_chunks, kv_chunk, d).transpose(1, 0, 2, 3)
-        vc = vh.reshape(h, n_chunks, kv_chunk, d).transpose(1, 0, 2, 3)
+    if use_flash:
+        from tpu_distalg.ops.pallas_attention import flash_attention_block
 
-        def chunk_step(carry, xs):
-            kcc, vcc, c = xs
-            mask = None
-            if causal:
-                k_pos = (src * s_local + c * kv_chunk
-                         + jnp.arange(kv_chunk))
-                mask = q_pos[:, None] >= k_pos[None, :]
-            return _online_update(qh, *carry, kcc, vcc, s, mask), None
+        def process_block(kh, vh, o, m, l, src):
+            o, m, l = flash_attention_block(
+                qh, kh, vh, o, m[..., None], l[..., None],
+                my * s_q, src * s_local, scale=s, causal=causal,
+                bq=flash_block_q, bkv=flash_block_kv,
+                interpret=flash_interpret,
+            )
+            return o, m[..., 0], l[..., 0]
+    else:
+        def process_block(kh, vh, o, m, l, src):
+            # kh, vh: (H, S_local, d) — transposed ONCE before the ring
+            # loop; ppermute commutes with the transpose, so blocks
+            # rotate in this layout and no per-ring-step relayout is
+            # paid
+            if kv_chunk is None or kv_chunk >= s_local:
+                mask = None
+                if causal:
+                    k_pos = src * s_local + jnp.arange(s_local)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                return _online_update(qh, o, m, l, kh, vh, s, mask)
+            n_chunks = s_local // kv_chunk
+            kc = kh.reshape(h, n_chunks, kv_chunk, d).transpose(
+                1, 0, 2, 3)
+            vc = vh.reshape(h, n_chunks, kv_chunk, d).transpose(
+                1, 0, 2, 3)
 
-        (o, m, l), _ = lax.scan(
-            chunk_step, (o, m, l), (kc, vc, jnp.arange(n_chunks))
-        )
-        return o, m, l
+            def chunk_step(carry, xs):
+                kcc, vcc, c = xs
+                mask = None
+                if causal:
+                    k_pos = (src * s_local + c * kv_chunk
+                             + jnp.arange(kv_chunk))
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                return _online_update(qh, *carry, kcc, vcc, s, mask), None
+
+            (o, m, l), _ = lax.scan(
+                chunk_step, (o, m, l), (kc, vc, jnp.arange(n_chunks))
+            )
+            return o, m, l
 
     def body(i, carry):
         kh, vh, o, m, l = carry
