@@ -365,3 +365,28 @@ def test_ring_attention_flash_matches_dense(mesh8):
         np.testing.assert_allclose(
             out, _dense_attention(q, k, v, causal=causal),
             rtol=2e-4, atol=2e-4, err_msg=f"causal={causal}")
+
+
+def test_ulysses_attention_flash_matches_dense(mesh8):
+    """Ulysses with the flash kernel as its local attention (interpret
+    mode; default 2048-tile blocks degrade to one tile at S=512)."""
+    import functools
+
+    rng = np.random.default_rng(14)
+    S, H, d = 512, 8, 128
+    q = rng.normal(size=(S, H, d)).astype(np.float32)
+    k = rng.normal(size=(S, H, d)).astype(np.float32)
+    v = rng.normal(size=(S, H, d)).astype(np.float32)
+    qs, ks, vs = (parallelize(x, mesh8) for x in (q, k, v))
+    for causal in (False, True):
+        f = data_parallel(
+            functools.partial(ulysses_attention, causal=causal,
+                              use_flash=True, flash_interpret=True),
+            mesh8,
+            in_specs=(P("data", None, None),) * 3,
+            out_specs=P("data", None, None),
+        )
+        out = np.asarray(jax.jit(f)(qs.data, ks.data, vs.data))
+        np.testing.assert_allclose(
+            out, _dense_attention(q, k, v, causal=causal),
+            rtol=2e-4, atol=2e-4, err_msg=f"causal={causal}")

@@ -229,15 +229,31 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
 
 
 def softmax_attention(q, k, v, *, scale: float | None = None,
-                      causal: bool = False):
+                      causal: bool = False, use_flash: bool = False,
+                      flash_interpret: bool = False):
     """Dense reference attention, (S, H, d) × (T, H, d) → (S, H, d).
 
     Materialises the full (H, S, T) score tensor — the local compute of
     :func:`ulysses_attention` and the oracle the ring variants are tested
-    against. Use the ring for long sequences.
+    against. ``use_flash=True`` runs the Pallas flash kernel instead
+    (tiled, no (H, S, T) materialisation — forward-only, see
+    ``ops.pallas_attention``).
     """
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
+    if use_flash:
+        from tpu_distalg.ops.pallas_attention import flash_attention_block
+
+        qh = jnp.moveaxis(q, 1, 0)                    # (H, S, d)
+        h, s_q, _ = qh.shape
+        o, _, l = flash_attention_block(
+            qh, jnp.moveaxis(k, 1, 0), jnp.moveaxis(v, 1, 0),
+            jnp.zeros((h, s_q, d), jnp.float32),
+            jnp.full((h, s_q, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((h, s_q, 1), jnp.float32),
+            0, 0, scale=s, causal=causal, interpret=flash_interpret,
+        )
+        return jnp.moveaxis(o / l, 0, 1)
     scores = jnp.einsum(
         "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
     ) * s
@@ -253,22 +269,27 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
 
 
 def ulysses_attention(q, k, v, axis_name: str = DATA_AXIS, *,
-                      scale: float | None = None, causal: bool = False):
+                      scale: float | None = None, causal: bool = False,
+                      use_flash: bool = False,
+                      flash_interpret: bool = False):
     """DeepSpeed-Ulysses sequence-parallel attention.
 
     ``q, k, v``: (S_local, H, d) sequence-sharded. One ``all_to_all``
     re-shards to (S, H_local, d) — every chip holds the FULL sequence for
-    H/n of the heads — dense attention runs locally per head (positions
+    H/n of the heads — attention runs locally per head (positions
     are global, so ``causal`` needs no cross-shard bookkeeping), and the
     inverse exchange restores (S_local, H, d). Exact; requires H
-    divisible by the axis size. Peak memory is O(S²·H/n) for the score
-    tensor — prefer :func:`ring_attention` when S_local² is the binding
-    constraint.
+    divisible by the axis size. ``use_flash=True`` runs the local
+    attention through the Pallas flash kernel (no full score tensor —
+    forward-only); otherwise peak memory is O(S²·H/n) — prefer
+    :func:`ring_attention` when that binds.
     """
     qh = alltoall_seq_to_head(q, axis_name)
     kh = alltoall_seq_to_head(k, axis_name)
     vh = alltoall_seq_to_head(v, axis_name)
-    o = softmax_attention(qh, kh, vh, scale=scale, causal=causal)
+    o = softmax_attention(qh, kh, vh, scale=scale, causal=causal,
+                          use_flash=use_flash,
+                          flash_interpret=flash_interpret)
     return alltoall_head_to_seq(o, axis_name)
 
 
