@@ -1,6 +1,6 @@
 """Benchmark driver — prints ONE JSON line per metric (SSGD first).
 
-Metrics (BASELINE.json):
+Headline metrics (BASELINE.json):
   1. SSGD logistic-regression steps/sec/chip on a 1M-row synthetic
      two-class task (125 features + bias; with the packed label/validity
      columns the design matrix is exactly 128 wide — one lane tile),
@@ -8,6 +8,12 @@ Metrics (BASELINE.json):
      schedule at benchmark scale.
   2. PageRank iterations/sec on a 1M-vertex, ~8M-edge Erdős–Rényi graph
      (``graph_computation/pagerank.py:50-57`` at benchmark scale).
+
+Additional recorded lines (TPU only): 100M-row SSGD with on-device
+synthesis (host RAM O(1)), the MA/BMUF/EASGD local-step rate (megakernel
+local rounds), 10M-point k-means, 4096×16384 rank-64 ALS, and 32k-token
+causal flash attention — each with spread and, where the workload is
+HBM-bound, its roofline fraction.
 
 On TPU the SSGD step runs the whole-schedule megakernel on single-shard
 meshes (``sampler='fused_train'``: weights in VMEM, update in-kernel,

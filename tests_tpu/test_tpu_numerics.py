@@ -156,3 +156,37 @@ def test_local_fused_train_convergence(tpu_mesh, cancer_data):
             gather_block_rows=64, fused_pack=4, shuffle_seed=0))
     # reference MA golden 0.8538 (ma.py:131); measured 0.8947 on TPU
     assert res.final_acc >= 0.85, res.final_acc
+
+
+def test_flash_attention_matches_xla_path(tpu_mesh):
+    """The Mosaic flash kernel and the XLA online-softmax ring agree on
+    real hardware (both paths round scores through bf16 matmul passes)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_distalg.parallel import DATA_AXIS, data_parallel
+    from tpu_distalg.parallel.ring import ring_attention
+    from tpu_distalg.utils import prng
+
+    S, H, d = 2048, 4, 128
+    key = prng.root_key(3)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (S, H, d),
+                          jnp.bfloat16)
+        for i in range(3)
+    )
+    outs = []
+    for kw in (dict(kv_chunk=512), dict(use_flash=True)):
+        f = jax.jit(data_parallel(
+            functools.partial(ring_attention, causal=True, **kw),
+            tpu_mesh,
+            in_specs=(P(DATA_AXIS, None, None),) * 3,
+            out_specs=P(DATA_AXIS, None, None),
+        ))
+        outs.append(np.asarray(f(q, k, v)))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-2, atol=2e-2)
+    assert np.isfinite(outs[1]).all()
