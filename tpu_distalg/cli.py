@@ -120,7 +120,14 @@ def main(argv=None):
     p.add_argument("--converge-dist", type=float, default=None)
     p.add_argument("--n-points", type=int, default=0,
                    help="0 = the reference's toy 6x2 matrix; else a "
-                        "Gaussian mixture of this many points")
+                        "Gaussian mixture of this many points "
+                        "(host-materialized, like the reference)")
+    p.add_argument("--scale-points", type=int, default=0,
+                   help="scale path: synthesize this many mixture "
+                        "points ON DEVICE (host RAM O(k); overrides "
+                        "--n-points)")
+    p.add_argument("--dim", type=int, default=16,
+                   help="point dimension for --scale-points")
     p.add_argument("--plot", type=str, default=None,
                    help="save a cluster scatter PNG (2-D data)")
 
@@ -258,14 +265,29 @@ def _dispatch(args, jax):
         from tpu_distalg.models import kmeans as m
         from tpu_distalg.utils import datasets
 
-        pts = (datasets.toy_kmeans_matrix() if args.n_points == 0
-               else datasets.gaussian_mixture(args.n_points, k=args.k))
-        res = m.fit(pts, _mesh(args), m.KMeansConfig(
-            k=args.k, n_iterations=args.n_iterations,
-            converge_dist=args.converge_dist))
+        if args.scale_points:
+            make_rows, _ = datasets.gaussian_mixture_rows(
+                k=args.k, dim=args.dim, seed=0)
+            res = m.fit_scaled(
+                _mesh(args), args.scale_points, make_rows,
+                m.KMeansConfig(k=args.k,
+                               n_iterations=args.n_iterations,
+                               converge_dist=args.converge_dist,
+                               init="farthest"))
+            pts = None  # points never leave the devices (O(k) host RAM)
+        else:
+            pts = (datasets.toy_kmeans_matrix() if args.n_points == 0
+                   else datasets.gaussian_mixture(args.n_points,
+                                                  k=args.k))
+            res = m.fit(pts, _mesh(args), m.KMeansConfig(
+                k=args.k, n_iterations=args.n_iterations,
+                converge_dist=args.converge_dist))
         print(f"Final centers: {res.centers.tolist()}")
         print(f"iterations run: {res.n_iterations_run}")
-        if args.plot:
+        if args.plot and pts is None:
+            print("--plot ignored with --scale-points (points stay "
+                  "on device)")
+        elif args.plot:
             from tpu_distalg.utils import metrics
 
             import numpy as np
