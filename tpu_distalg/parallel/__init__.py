@@ -32,6 +32,14 @@ from tpu_distalg.parallel.collectives import (
     ring_shift,
 )
 from tpu_distalg.parallel.spmd import data_parallel, replica_index
+from tpu_distalg.parallel.ring import (
+    alltoall_head_to_seq,
+    alltoall_seq_to_head,
+    ring_allgather_matmul,
+    ring_attention,
+    softmax_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -40,6 +48,8 @@ __all__ = [
     "ShardedMatrix",
     "all_gather",
     "all_to_all",
+    "alltoall_head_to_seq",
+    "alltoall_seq_to_head",
     "build_sharded",
     "data_parallel",
     "data_sharding",
@@ -51,7 +61,11 @@ __all__ = [
     "replica_index",
     "replicate",
     "replicated_sharding",
+    "ring_allgather_matmul",
+    "ring_attention",
     "ring_shift",
+    "softmax_attention",
     "tree_allreduce_mean",
     "tree_allreduce_sum",
+    "ulysses_attention",
 ]
