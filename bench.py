@@ -59,9 +59,9 @@ N_REPEATS = 3
 # ~100 ms — timing a single call would charge ~60 us/step of HOST
 # round-trip to the DEVICE rate (measured: a trivial 1500-step scan
 # "costs" 63 us/step at chain=1, 4.5 us/step at chain=16). Chaining
-# amortizes the round-trip to <5 us/step; still conservative (see
+# amortizes the round-trip to ~2 us/step; still conservative (see
 # utils/profiling.steps_per_sec).
-N_CHAIN = 16
+N_CHAIN = 32
 GATHER_BLOCK_ROWS = 8192
 ASSUMED_SPARK_JOBS_PER_SEC = 20.0
 PR_VERTICES = 1_000_000
@@ -382,7 +382,10 @@ def _bench_kmeans_scale(mesh, n_chips):
     from tpu_distalg.models import kmeans
     from tpu_distalg.utils import datasets, profiling
 
-    n_rows, k, dim, iters = 10_000_000, 8, 16, 20
+    # 50 iters/call: at ~2.8 ms/iter a 20-iter call is ~56 ms of device
+    # time vs the ~100 ms tunnel round-trip — longer calls keep the
+    # chain-amortized residue under ~5%
+    n_rows, k, dim, iters = 10_000_000, 8, 16, 50
     make_rows, true_centers = datasets.gaussian_mixture_rows(
         k=k, dim=dim, seed=0, spread=8.0)
     cfg = kmeans.KMeansConfig(k=k, n_iterations=iters, seed=0,
@@ -502,7 +505,10 @@ def _bench_als(mesh, n_chips):
     from tpu_distalg.models import als
     from tpu_distalg.utils import profiling, prng
 
-    m, n, k, sweeps = 4096, 16384, 64, 10
+    # 50 sweeps per timed call: at ~2 ms/sweep a 10-sweep call is
+    # ~20 ms of device time — the tunnel round-trip would dominate and
+    # under-report by 3-4x (measured 119-176 vs ~500 device-side)
+    m, n, k, sweeps = 4096, 16384, 64, 50
     cfg = als.ALSConfig(m=m, n=n, k=k, lam=0.0, n_iterations=sweeps)
     key = prng.root_key(cfg.seed)
     U0 = jax.random.normal(jax.random.fold_in(key, 0), (m, k)) * 0.3
@@ -513,7 +519,7 @@ def _bench_als(mesh, n_chips):
     fn = als.make_fit_fn(mesh, cfg)
     best, spread, (_, _, errs) = profiling.steps_per_sec(
         lambda: fn(R, Ui, Vi), steps=sweeps, with_stats=True,
-        with_output=True, repeats=N_REPEATS, chain=4)
+        with_output=True, repeats=N_REPEATS, chain=8)
 
     print(json.dumps({
         "metric": "als_4kx16k_sweeps_per_sec_per_chip",
