@@ -7,7 +7,9 @@ measured ~13 TFLOP/s at 32k tokens. Here the whole
 QKᵀ → mask → online-softmax → ·V pipeline runs per (q-block, kv-block)
 tile while it is VMEM-resident (the standard flash-attention
 formulation: Dao et al.; Rabe-Staats chunked softmax), with the MXU
-doing both matmuls back-to-back.
+doing both matmuls back-to-back. Measured (one v5e, 8 heads, d=128,
+causal): 49 TFLOP/s at 32k tokens, 101 TFLOP/s at 128k tokens — a
+single chip covers 128k-token causal attention.
 
 The kernel CARRIES the online-softmax state (o, m, l) in and out, so
 it slots directly into ring attention: each arriving K/V block is one
