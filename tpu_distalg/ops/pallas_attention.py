@@ -103,17 +103,20 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
                           interpret: bool = False):
     """One resident K/V block folded into the online-softmax state.
 
-    ``q``: (H, S_q, d); ``k``, ``v``: (H, S_kv, d); state ``o``:
-    (H, S_q, d) f32, ``m``, ``l``: (H, S_q, 1) f32 (``m`` starts at
-    -inf, ``l``/``o`` at 0). ``q_off``/``k_off``: global positions of
-    row 0 (traced scalars — the ring rotates ``k_off`` per step).
+    ``q``: (H, S_q, d); ``k``, ``v``: (H_kv, S_kv, d) with H divisible
+    by H_kv — grouped-query attention costs nothing extra: query head h
+    reads KV head ``h // (H/H_kv)`` straight from the block index map,
+    no KV replication in HBM or VMEM. State ``o``: (H, S_q, d) f32,
+    ``m``, ``l``: (H, S_q, 1) f32 (``m`` starts at -inf, ``l``/``o`` at
+    0). ``q_off``/``k_off``: global positions of row 0 (traced scalars
+    — the ring rotates ``k_off`` per step).
     Returns the updated (o, m, l); normalise ``o / l`` after the LAST
     block. Requires d a lane-tile multiple and S_q % bq == S_kv % bkv
     == 0 — unsupported shapes raise at trace time (use the XLA path,
     ``ring_attention(use_flash=False)``, for them).
     """
     h, s_q, d = q.shape
-    s_kv = k.shape[1]
+    h_kv, s_kv = k.shape[0], k.shape[1]
     bq = min(bq, s_q)
     bkv = min(bkv, s_kv)
     if d % 128 or s_q % bq or s_kv % bkv or bq % 8 or bkv % 128:
@@ -121,11 +124,22 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
             f"flash_attention_block: shapes q={q.shape} k={k.shape} "
             f"need d%128==0 and divisible blocks (bq={bq}, bkv={bkv})"
         )
+    if v.shape != k.shape:
+        raise ValueError(
+            f"flash_attention_block: v {v.shape} must match k "
+            f"{k.shape} — both ride the same KV-head index map"
+        )
+    if h % h_kv:
+        raise ValueError(
+            f"flash_attention_block: {h} query heads not divisible by "
+            f"{h_kv} KV heads"
+        )
+    group = h // h_kv
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal, bq=bq, bkv=bkv)
     grid = (h, s_q // bq, s_kv // bkv)
-    qs = lambda hh, i, j, s: (hh, i, 0)    # noqa: E731
-    ks = lambda hh, i, j, s: (hh, j, 0)    # noqa: E731
+    qs = lambda hh, i, j, s: (hh, i, 0)            # noqa: E731
+    ks = lambda hh, i, j, s: (hh // group, j, 0)   # noqa: E731
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     return pl.pallas_call(

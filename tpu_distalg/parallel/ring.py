@@ -143,6 +143,12 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_q, h, d = q.shape
+    if h % k.shape[1]:
+        raise ValueError(
+            f"ring_attention: {h} query heads not divisible by "
+            f"{k.shape[1]} KV heads"
+        )
+    gqa_group = h // k.shape[1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     qh = jnp.moveaxis(q, 1, 0)                     # (H, Sq, d)
     s_local = k.shape[0]
@@ -171,10 +177,14 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             return o, m[..., 0], l[..., 0]
     else:
         def process_block(kh, vh, o, m, l, src):
-            # kh, vh: (H, S_local, d) — transposed ONCE before the ring
-            # loop; ppermute commutes with the transpose, so blocks
-            # rotate in this layout and no per-ring-step relayout is
-            # paid
+            # kh, vh: (H_kv, S_local, d) — transposed ONCE before the
+            # ring loop; ppermute commutes with the transpose, so
+            # blocks rotate in this layout and no per-ring-step
+            # relayout is paid. Grouped-query KV heads broadcast here,
+            # AFTER the rotate, so the ring moves only H_kv heads
+            if gqa_group > 1:
+                kh = jnp.repeat(kh, gqa_group, axis=0)
+                vh = jnp.repeat(vh, gqa_group, axis=0)
             if kv_chunk is None or kv_chunk >= s_local:
                 mask = None
                 if causal:
@@ -240,6 +250,11 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
     ``ops.pallas_attention``).
     """
     d = q.shape[-1]
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"softmax_attention: {q.shape[1]} query heads not "
+            f"divisible by {k.shape[1]} KV heads"
+        )
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if use_flash:
         from tpu_distalg.ops.pallas_attention import flash_attention_block
@@ -254,6 +269,13 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
             0, 0, scale=s, causal=causal, interpret=flash_interpret,
         )
         return jnp.moveaxis(o / l, 0, 1)
+    if k.shape[1] != q.shape[1]:
+        # grouped-query on the XLA path: broadcast the KV heads (the
+        # flash path reads the shared head via its block index map
+        # instead — zero-copy)
+        g = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     scores = jnp.einsum(
         "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
     ) * s
