@@ -69,15 +69,27 @@ def ring_allgather_matmul(a_local, b_local, axis_name: str = DATA_AXIS):
 def _online_update(qh, o, m, l, kh, vh, scale, mask):
     """One online-softmax accumulation step over a resident K/V chunk.
 
-    ``qh``: (H, Sq, d); ``kh, vh``: (H, C, d); state ``o``: (H, Sq, d),
-    ``m, l``: (H, Sq). ``mask``: (Sq, C) boolean (True = attend) or None.
-    Fully-masked rows are handled safely: while ``m`` is still −inf the
-    rescale factor and probabilities are forced to 0 instead of exp(−inf −
-    −inf) = NaN.
+    ``qh``: (H, Sq, d); ``kh, vh``: (H_kv, C, d) with H divisible by
+    H_kv — grouped-query KV heads are consumed through a zero-copy
+    grouped einsum view (query heads [hk·g, hk·g+g) read KV head hk;
+    no KV replication). State ``o``: (H, Sq, d), ``m, l``: (H, Sq).
+    ``mask``: (Sq, C) boolean (True = attend) or None. Fully-masked
+    rows are handled safely: while ``m`` is still −inf the rescale
+    factor and probabilities are forced to 0 instead of
+    exp(−inf − −inf) = NaN.
     """
-    scores = jnp.einsum(
-        "hqd,hkd->hqk", qh, kh, preferred_element_type=jnp.float32
-    ) * scale
+    h, s_q, d_ = qh.shape
+    h_kv, c = kh.shape[0], kh.shape[1]
+    g = h // h_kv
+    if g == 1:
+        scores = jnp.einsum(
+            "hqd,hkd->hqk", qh, kh, preferred_element_type=jnp.float32
+        ) * scale
+    else:
+        scores = jnp.einsum(
+            "hgqd,hkd->hgqk", qh.reshape(h_kv, g, s_q, d_), kh,
+            preferred_element_type=jnp.float32,
+        ).reshape(h, s_q, c) * scale
     if mask is not None:
         scores = jnp.where(mask[None], scores, -jnp.inf)
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
@@ -87,10 +99,16 @@ def _online_update(qh, o, m, l, kh, vh, scale, mask):
         safe[..., None], jnp.exp(scores - m_new[..., None]), 0.0
     )
     l = l * alpha + jnp.sum(p, axis=-1)
-    o = o * alpha[..., None] + jnp.einsum(
-        "hqk,hkd->hqd", p.astype(vh.dtype), vh,
-        preferred_element_type=jnp.float32,
-    )
+    pv = p.astype(vh.dtype)
+    if g == 1:
+        upd = jnp.einsum("hqk,hkd->hqd", pv, vh,
+                         preferred_element_type=jnp.float32)
+    else:
+        upd = jnp.einsum(
+            "hgqk,hkd->hgqd", pv.reshape(h_kv, g, s_q, c), vh,
+            preferred_element_type=jnp.float32,
+        ).reshape(h, s_q, d_)
+    o = o * alpha[..., None] + upd
     return o, m_new, l
 
 
@@ -132,11 +150,55 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     ``use_flash=True`` swaps the XLA update for the Pallas flash kernel
     (``ops.pallas_attention.flash_attention_block``): the whole
     QKᵀ→softmax→·V pipeline runs per VMEM-resident tile — same algebra
-    and f32 accumulation, much less HBM traffic. Forward-only (no VJP;
-    use the XLA path for training), needs head-dim a multiple of 128
-    and block-divisible lengths, supersedes ``kv_chunk``. Set
+    and f32 accumulation, much less HBM traffic. Needs head-dim a
+    multiple of 128 and block-divisible lengths, supersedes
+    ``kv_chunk``. DIFFERENTIABLE via a custom VJP that runs the
+    backward through the exact XLA ring (Pallas kernels have no
+    autodiff): flash-fast forward, XLA-cost backward — both compute
+    the same values, so the gradients are exact. Set
     ``flash_interpret=True`` on CPU meshes (tests).
     """
+    if use_flash:
+        impl = functools.partial(
+            _ring_attention_impl, axis_name=axis_name, scale=scale,
+            kv_chunk=kv_chunk, causal=causal,
+            flash_interpret=flash_interpret,
+            flash_block_q=flash_block_q, flash_block_kv=flash_block_kv,
+        )
+
+        @jax.custom_vjp
+        def flash_fn(q, k, v):
+            return impl(q, k, v, use_flash=True)
+
+        def _fwd(q, k, v):
+            return flash_fn(q, k, v), (q, k, v)
+
+        def _bwd(res, g):
+            qq, kk, vv = res
+            # memory-safe backward: chunk the XLA path's score tiles
+            s_loc = kk.shape[0]
+            chunk = 2048
+            while chunk > 1 and s_loc % chunk:
+                chunk //= 2
+            _, vjp = jax.vjp(
+                functools.partial(impl, use_flash=False,
+                                  kv_chunk=chunk),
+                qq, kk, vv)
+            return vjp(g)
+
+        flash_fn.defvjp(_fwd, _bwd)
+        return flash_fn(q, k, v)
+    return _ring_attention_impl(
+        q, k, v, axis_name=axis_name, scale=scale, kv_chunk=kv_chunk,
+        causal=causal, use_flash=False,
+        flash_interpret=flash_interpret,
+        flash_block_q=flash_block_q, flash_block_kv=flash_block_kv,
+    )
+
+
+def _ring_attention_impl(q, k, v, *, axis_name, scale, kv_chunk,
+                         causal, use_flash, flash_interpret,
+                         flash_block_q, flash_block_kv):
     single = q.ndim == 2
     if single:
         q, k, v = (x[:, None, :] for x in (q, k, v))
@@ -148,7 +210,6 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             f"ring_attention: {h} query heads not divisible by "
             f"{k.shape[1]} KV heads"
         )
-    gqa_group = h // k.shape[1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     qh = jnp.moveaxis(q, 1, 0)                     # (H, Sq, d)
     s_local = k.shape[0]
@@ -180,11 +241,9 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             # kh, vh: (H_kv, S_local, d) — transposed ONCE before the
             # ring loop; ppermute commutes with the transpose, so
             # blocks rotate in this layout and no per-ring-step
-            # relayout is paid. Grouped-query KV heads broadcast here,
-            # AFTER the rotate, so the ring moves only H_kv heads
-            if gqa_group > 1:
-                kh = jnp.repeat(kh, gqa_group, axis=0)
-                vh = jnp.repeat(vh, gqa_group, axis=0)
+            # relayout is paid. Grouped-query KV heads are consumed by
+            # _online_update's grouped einsum view — the ring moves and
+            # the update reads only H_kv heads, no replication
             if kv_chunk is None or kv_chunk >= s_local:
                 mask = None
                 if causal:
@@ -192,9 +251,10 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
                     mask = q_pos[:, None] >= k_pos[None, :]
                 return _online_update(qh, o, m, l, kh, vh, s, mask)
             n_chunks = s_local // kv_chunk
-            kc = kh.reshape(h, n_chunks, kv_chunk, d).transpose(
+            h_kv = kh.shape[0]
+            kc = kh.reshape(h_kv, n_chunks, kv_chunk, d).transpose(
                 1, 0, 2, 3)
-            vc = vh.reshape(h, n_chunks, kv_chunk, d).transpose(
+            vc = vh.reshape(h_kv, n_chunks, kv_chunk, d).transpose(
                 1, 0, 2, 3)
 
             def chunk_step(carry, xs):
@@ -269,25 +329,23 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
             0, 0, scale=s, causal=causal, interpret=flash_interpret,
         )
         return jnp.moveaxis(o / l, 0, 1)
-    if k.shape[1] != q.shape[1]:
-        # grouped-query on the XLA path: broadcast the KV heads (the
-        # flash path reads the shared head via its block index map
-        # instead — zero-copy)
-        g = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, g, axis=1)
-        v = jnp.repeat(v, g, axis=1)
+    # grouped-query heads consumed through a zero-copy grouped einsum
+    # view, like _online_update — no KV replication on any path
+    s_q, h, _ = q.shape
+    t, h_kv = k.shape[0], k.shape[1]
+    g = h // h_kv
     scores = jnp.einsum(
-        "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
-    ) * s
+        "qhgd,khd->hgqk", q.reshape(s_q, h_kv, g, d), k,
+        preferred_element_type=jnp.float32,
+    ).reshape(h, s_q, t) * s
     if causal:
-        mask = (jnp.arange(q.shape[0])[:, None]
-                >= jnp.arange(k.shape[0])[None, :])
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(t)[None, :]
         scores = jnp.where(mask[None], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum(
-        "hqk,khd->qhd", p.astype(v.dtype), v,
+        "hgqk,khd->qhgd", p.astype(v.dtype).reshape(h_kv, g, s_q, t), v,
         preferred_element_type=jnp.float32,
-    )
+    ).reshape(s_q, h, d)
 
 
 def ulysses_attention(q, k, v, axis_name: str = DATA_AXIS, *,
