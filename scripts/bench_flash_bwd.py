@@ -1,0 +1,43 @@
+"""A/B: flash fwd+bwd (Pallas backward) vs XLA-ring backward.
+
+The XLA path's vjp saves every (H, S, chunk) probability tile — H*S^2*4
+bytes of residuals (32 GB at S=32k, H=8) — so it plain OOMs beyond ~12k
+tokens on a 16 GB chip. The flash backward saves only (O, lse) and
+recomputes P per VMEM tile, so 32k+ trains on one chip.
+"""
+import functools
+import jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from tpu_distalg.parallel import DATA_AXIS, data_parallel, get_mesh
+from tpu_distalg.parallel.ring import ring_attention
+from tpu_distalg.utils import profiling, prng
+
+mesh = get_mesh()
+H, d = 8, 128
+
+def make(fn):
+    f = data_parallel(fn, mesh, in_specs=(P(DATA_AXIS, None, None),) * 3,
+                      out_specs=P(DATA_AXIS, None, None))
+    def loss(q_, k_, v_):
+        return jnp.sum(f(q_, k_, v_).astype(jnp.float32) ** 2)
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+def run(name, fn, S):
+    key = prng.root_key(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (S, H, d), jnp.bfloat16)
+               for i in range(3))
+    g = make(fn)
+    best, spread = profiling.steps_per_sec(lambda: g(q, k, v), steps=1,
+                                           with_stats=True, repeats=3, chain=4)
+    flops = S * S / 2 * d * H * 2 * 2 * 3.5   # causal fwd + 2.5x bwd
+    print(f"{name} S={S}: {best:.2f} calls/s -> {flops*best/1e12:.1f} TFLOP/s fwd+bwd  spread={spread}", flush=True)
+
+flash = functools.partial(ring_attention, causal=True, use_flash=True)
+xla = functools.partial(ring_attention, causal=True, kv_chunk=1024)
+run("flash", flash, 8192)
+run("xla  ", xla, 8192)
+run("flash", flash, 32768)
+try:
+    run("xla  ", xla, 32768)
+except Exception as e:
+    print(f"xla   S=32768: OOM ({type(e).__name__})", flush=True)

@@ -1,4 +1,4 @@
-"""Flash-attention Pallas kernel for the ring/sequence-parallel path.
+"""Flash-attention Pallas kernels for the ring/sequence-parallel path.
 
 The XLA online-softmax update (``parallel/ring._online_update``)
 materialises each (S_q, kv_chunk) score tile in HBM between the two
@@ -11,18 +11,31 @@ doing both matmuls back-to-back. Measured (one v5e, 8 heads, d=128,
 causal): 49 TFLOP/s at 32k tokens, 101 TFLOP/s at 128k tokens — a
 single chip covers 128k-token causal attention.
 
-The kernel CARRIES the online-softmax state (o, m, l) in and out, so
-it slots directly into ring attention: each arriving K/V block is one
-kernel call that continues the accumulation, and the final ``o / l``
-normalisation happens once at the end of the ring — numerics identical
-to the XLA path (same update algebra, same f32 accumulation).
+The forward kernel CARRIES the online-softmax state (o, m, l) in and
+out, so it slots directly into ring attention: each arriving K/V block
+is one kernel call that continues the accumulation, and the final
+``o / l`` normalisation happens once at the end of the ring — numerics
+identical to the XLA path (same update algebra, same f32 accumulation).
+
+The BACKWARD (``flash_attention_backward_block``) is the FlashAttention-2
+recompute formulation: given the saved normalised output O and per-row
+logsumexp L = m + log l, each tile recomputes P = exp(QKᵀ·s − L) in
+VMEM and feeds the five tile matmuls (QKᵀ, dO·Vᵀ, dS·K, dSᵀ·Q, Pᵀ·dO)
+without ever materialising an (S_q, S_kv) tensor in HBM. It is split
+into two kernels because the two accumulation directions conflict on a
+TPU grid: dQ sums over KV blocks (inner grid axis = KV), while dK/dV
+sum over Q blocks (inner grid axis = Q, with grouped-query heads folded
+into the inner axis so each KV head's cotangent accumulates over its
+whole query group in one consecutive VMEM-resident run).
 
 Causality is positional: ``q_off``/``k_off`` give the global positions
 of the local Q rows and the resident K/V block (they change as blocks
 rotate around the ring), passed as scalar-prefetch operands so one
 compiled kernel serves every ring step. Masked logits use a finite
 -1e30 sentinel (±inf breeds NaNs through 0·inf in rescales); a guard
-keeps fully-masked tiles from contributing exp(0) mass.
+keeps fully-masked tiles from contributing exp(0) mass. Both backward
+kernels skip fully-masked (strictly-upper-diagonal) tiles the same way
+the forward does, so the causal backward also saves ~2× FLOPs.
 """
 
 from __future__ import annotations
@@ -177,3 +190,230 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
         ),
         interpret=interpret,
     )(offs, q, k, v, o, m, l)
+
+
+def _recompute_p(off_ref, q, k, lse, qi, kj, *, scale, causal, bq, bkv):
+    """Shared tile recompute: normalised P = exp(QKᵀ·scale − L).
+
+    ``lse`` is the FINAL per-row logsumexp over the full (ring-wide)
+    sequence, so P is the true softmax probability — no rescaling chain
+    in the backward, every tile is independent given (L, D).
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                           # (Bq, Bkv)
+    p = jnp.exp(s - lse)
+    if causal:
+        qpos = (off_ref[0] + qi * bq
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0))
+        kpos = (off_ref[1] + kj * bkv
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1))
+        p = jnp.where(qpos >= kpos, p, 0.0)
+    return p
+
+
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dqacc, *,
+                   scale: float, causal: bool, bq: int, bkv: int):
+    i = pl.program_id(1)                                # q block
+    j = pl.program_id(2)                                # kv block (inner)
+
+    @pl.when(j == 0)
+    def _init():
+        dqacc[:] = jnp.zeros_like(dqacc)
+
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        p = _recompute_p(off_ref, q, k, lse_ref[0], i, j,
+                         scale=scale, causal=causal, bq=bq, bkv=bkv)
+        dp = jax.lax.dot_general(                       # dO·Vᵀ (Bq, Bkv)
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale            # (Bq, Bkv)
+        dqacc[:] += jax.lax.dot_general(                # dS·K (Bq, d)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(off_ref[0] + (i + 1) * bq - 1
+                >= off_ref[1] + j * bkv)(_tile)
+    else:
+        _tile()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _store():
+        dq_ref[0] = dqacc[:]
+
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dkacc, dvacc, *,
+                    scale: float, causal: bool, bq: int, bkv: int,
+                    n_q: int):
+    i = pl.program_id(1)                                # kv block
+    j = pl.program_id(2)                                # (group, q) inner
+    qi = j % n_q
+
+    @pl.when(j == 0)
+    def _init():
+        dkacc[:] = jnp.zeros_like(dkacc)
+        dvacc[:] = jnp.zeros_like(dvacc)
+
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        p = _recompute_p(off_ref, q, k, lse_ref[0], qi, i,
+                         scale=scale, causal=causal, bq=bq, bkv=bkv)
+        dvacc[:] += jax.lax.dot_general(                # Pᵀ·dO (Bkv, d)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(                       # dO·Vᵀ (Bq, Bkv)
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dkacc[:] += jax.lax.dot_general(                # dSᵀ·Q (Bkv, d)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(off_ref[0] + (qi + 1) * bq - 1
+                >= off_ref[1] + i * bkv)(_tile)
+    else:
+        _tile()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _store():
+        dk_ref[0] = dkacc[:]
+        dv_ref[0] = dvacc[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "causal", "bq", "bkv", "interpret"),
+)
+def flash_attention_backward_block(q, k, v, do, lse, delta,
+                                   q_off, k_off, *,
+                                   scale: float, causal: bool = False,
+                                   bq: int = 1024, bkv: int = 1024,
+                                   interpret: bool = False):
+    """Gradients through one resident K/V block (FlashAttention-2 style).
+
+    ``q, do``: (H, S_q, d); ``k, v``: (H_kv, S_kv, d); ``lse``
+    (final per-row logsumexp m + log l) and ``delta`` (Σ_d dO·O over the
+    normalised output): (H, S_q, 1) f32. Returns ``(dq, dk, dv)`` in
+    f32 — dq is this block's partial (sum over ring steps outside);
+    dk/dv are the full cotangents of THIS block w.r.t. the local
+    queries (sum over ring shards outside). Grouped-query heads fold
+    into the dK/dV kernel's inner grid axis, so each KV head's
+    cotangent group-sums in VMEM with no HBM-side segment reduce.
+
+    Default 1024-blocks keep the four (Bq, Bkv) f32 temporaries
+    (P, dP, dS and the score tile) near 16 MB total — the backward
+    holds more live tiles than the forward, so its default block is
+    half the forward's 2048.
+    """
+    h, s_q, d = q.shape
+    h_kv, s_kv = k.shape[0], k.shape[1]
+    # halve down to a divisor: the forward accepts any length whose
+    # clamped block divides it, so the backward must too (e.g.
+    # s_local=1536 clamps min(1024,1536)=1024 which does NOT divide —
+    # 512 does)
+    bq = min(bq, s_q)
+    while bq > 8 and s_q % bq:
+        bq //= 2
+    bkv = min(bkv, s_kv)
+    while bkv > 128 and s_kv % bkv:
+        bkv //= 2
+    if d % 128 or s_q % bq or s_kv % bkv or bq % 8 or bkv % 128:
+        raise ValueError(
+            f"flash_attention_backward_block: shapes q={q.shape} "
+            f"k={k.shape} need d%128==0 and divisible blocks "
+            f"(bq={bq}, bkv={bkv})"
+        )
+    if v.shape != k.shape or do.shape != q.shape:
+        raise ValueError(
+            "flash_attention_backward_block: v must match k and do "
+            f"must match q (got v={v.shape}, do={do.shape})"
+        )
+    if h % h_kv:
+        raise ValueError(
+            f"flash_attention_backward_block: {h} query heads not "
+            f"divisible by {h_kv} KV heads"
+        )
+    group = h // h_kv
+    n_q, n_kv = s_q // bq, s_kv // bkv
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    qs = lambda hh, i, j, s: (hh, i, 0)                # noqa: E731
+    ks = lambda hh, i, j, s: (hh // group, j, 0)       # noqa: E731
+    common = dict(scale=scale, causal=causal, bq=bq, bkv=bkv)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h, n_q, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), qs),           # q
+                pl.BlockSpec((1, bkv, d), ks),          # k
+                pl.BlockSpec((1, bkv, d), ks),          # v
+                pl.BlockSpec((1, bq, d), qs),           # do
+                pl.BlockSpec((1, bq, 1), qs),           # lse
+                pl.BlockSpec((1, bq, 1), qs),           # delta
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), qs),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)
+
+    # dK/dV: grid over KV heads × KV blocks, inner axis walks the whole
+    # query group × q-block range so the (hk, i) output block stays
+    # VMEM-resident across its entire accumulation
+    hq = lambda hk, i, j, s: (hk * group + j // n_q, j % n_q, 0)  # noqa: E731
+    kv = lambda hk, i, j, s: (hk, i, 0)                           # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common, n_q=n_q),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h_kv, n_kv, group * n_q),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), hq),           # q
+                pl.BlockSpec((1, bkv, d), kv),          # k
+                pl.BlockSpec((1, bkv, d), kv),          # v
+                pl.BlockSpec((1, bq, d), hq),           # do
+                pl.BlockSpec((1, bq, 1), hq),           # lse
+                pl.BlockSpec((1, bq, 1), hq),           # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bkv, d), kv),
+                pl.BlockSpec((1, bkv, d), kv),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bkv, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((h_kv, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((h_kv, s_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)
+    return dq, dk, dv

@@ -152,13 +152,20 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     QKᵀ→softmax→·V pipeline runs per VMEM-resident tile — same algebra
     and f32 accumulation, much less HBM traffic. Needs head-dim a
     multiple of 128 and block-divisible lengths, supersedes
-    ``kv_chunk``. DIFFERENTIABLE via a custom VJP that runs the
-    backward through the exact XLA ring (Pallas kernels have no
-    autodiff): flash-fast forward, XLA-cost backward — both compute
-    the same values, so the gradients are exact. Set
-    ``flash_interpret=True`` on CPU meshes (tests).
+    ``kv_chunk``. DIFFERENTIABLE end-to-end at flash speed: the custom
+    VJP saves (O, logsumexp) from the forward ring and runs a SECOND
+    ring of Pallas backward kernels
+    (``ops.pallas_attention.flash_attention_backward_block``) — K/V
+    blocks rotate again, each step recomputes P from the saved stats
+    per VMEM tile and emits (dQ partial, dK/dV of the resident block);
+    the dK/dV accumulators travel WITH their blocks so after n steps
+    each shard holds its own finished cotangent. Same algebra and f32
+    accumulation as differentiating the XLA path, so the gradients are
+    exact. Set ``flash_interpret=True`` on CPU meshes (tests).
     """
     if use_flash:
+        bwd_bq = min(flash_block_q, 1024)
+        bwd_bkv = min(flash_block_kv, 1024)
         impl = functools.partial(
             _ring_attention_impl, axis_name=axis_name, scale=scale,
             kv_chunk=kv_chunk, causal=causal,
@@ -171,20 +178,17 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             return impl(q, k, v, use_flash=True)
 
         def _fwd(q, k, v):
-            return flash_fn(q, k, v), (q, k, v)
+            out, lse = impl(q, k, v, use_flash=True, return_stats=True)
+            return out, (q, k, v, out, lse)
 
         def _bwd(res, g):
-            qq, kk, vv = res
-            # memory-safe backward: chunk the XLA path's score tiles
-            s_loc = kk.shape[0]
-            chunk = 2048
-            while chunk > 1 and s_loc % chunk:
-                chunk //= 2
-            _, vjp = jax.vjp(
-                functools.partial(impl, use_flash=False,
-                                  kv_chunk=chunk),
-                qq, kk, vv)
-            return vjp(g)
+            qq, kk, vv, out, lse = res
+            return _ring_flash_backward(
+                qq, kk, vv, out, lse, g, axis_name=axis_name,
+                scale=scale, causal=causal,
+                flash_interpret=flash_interpret,
+                bq=bwd_bq, bkv=bwd_bkv,
+            )
 
         flash_fn.defvjp(_fwd, _bwd)
         return flash_fn(q, k, v)
@@ -196,9 +200,82 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     )
 
 
+def _ring_flash_backward(q, k, v, out, lse, g, *, axis_name, scale,
+                         causal, flash_interpret, bq, bkv):
+    """Ring of flash backward kernels — dK/dV accumulators ride along.
+
+    Forward residuals: ``out`` (normalised, f32, caller layout) and
+    ``lse`` (H, S_q, 1) — the FINAL ring-wide logsumexp, so every
+    backward tile recomputes the true softmax P independently; no
+    rescaling chain crosses ring steps. Each of the n steps feeds the
+    resident K/V block and ITS travelling (dk, dv) accumulator through
+    ``flash_attention_backward_block``; dQ accumulates locally. The
+    rotation count is n, so every (block, accumulator) pair ends the
+    loop back on its owner shard. Comm volume is 2× the forward ring
+    (4 rotating buffers) — the standard ring-attention backward cost.
+    """
+    from tpu_distalg.ops.pallas_attention import (
+        flash_attention_backward_block,
+    )
+
+    single = q.ndim == 2
+    if single:
+        q, k, v, out, g = (x[:, None, :] for x in (q, k, v, out, g))
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_q, h, d = q.shape
+    s_local = k.shape[0]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.moveaxis(q, 1, 0)                        # (H, Sq, d)
+    kh0 = jnp.moveaxis(k, 1, 0)                       # (H_kv, Sl, d)
+    vh0 = jnp.moveaxis(v, 1, 0)
+    doh = jnp.moveaxis(g, 1, 0).astype(jnp.float32)
+    oh = jnp.moveaxis(out, 1, 0).astype(jnp.float32)
+    delta = jnp.sum(doh * oh, axis=-1, keepdims=True)  # (H, Sq, 1)
+
+    def body(i, carry):
+        kh, vh, dk, dv, dq = carry
+        src = (my - i) % n
+
+        def compute(args):
+            dq, dk, dv = args
+            dq_c, dk_c, dv_c = flash_attention_backward_block(
+                qh, kh, vh, doh, lse, delta,
+                my * s_q, src * s_local, scale=s, causal=causal,
+                bq=bq, bkv=bkv, interpret=flash_interpret,
+            )
+            return dq + dq_c, dk + dk_c, dv + dv_c
+
+        if causal:
+            dq, dk, dv = lax.cond(
+                src <= my, compute, lambda a: a, (dq, dk, dv))
+        else:
+            dq, dk, dv = compute((dq, dk, dv))
+        perm = _ring_perm(n)
+        kh = lax.ppermute(kh, axis_name, perm)
+        vh = lax.ppermute(vh, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return kh, vh, dk, dv, dq
+
+    zeros = functools.partial(jnp.zeros, dtype=jnp.float32)
+    _, _, dk, dv, dq = lax.fori_loop(
+        0, n, body,
+        (kh0, vh0, zeros(kh0.shape), zeros(vh0.shape),
+         zeros((h, s_q, d))),
+    )
+    dq = jnp.moveaxis(dq, 0, 1).astype(q.dtype)
+    dk = jnp.moveaxis(dk, 0, 1).astype(k.dtype)
+    dv = jnp.moveaxis(dv, 0, 1).astype(v.dtype)
+    if single:
+        dq, dk, dv = (x[:, 0, :] for x in (dq, dk, dv))
+    return dq, dk, dv
+
+
 def _ring_attention_impl(q, k, v, *, axis_name, scale, kv_chunk,
                          causal, use_flash, flash_interpret,
-                         flash_block_q, flash_block_kv):
+                         flash_block_q, flash_block_kv,
+                         return_stats=False):
     single = q.ndim == 2
     if single:
         q, k, v = (x[:, None, :] for x in (q, k, v))
@@ -293,9 +370,14 @@ def _ring_attention_impl(q, k, v, *, axis_name, scale, kv_chunk,
     l0 = jnp.zeros((h, s_q), dtype=jnp.float32)
     kh0 = jnp.moveaxis(k, 1, 0)                    # (H, S_local, d)
     vh0 = jnp.moveaxis(v, 1, 0)
-    _, _, o, _, l = lax.fori_loop(0, n, body, (kh0, vh0, o0, m0, l0))
+    _, _, o, m, l = lax.fori_loop(0, n, body, (kh0, vh0, o0, m0, l0))
     out = jnp.moveaxis(o / l[..., None], 0, 1)     # (Sq, H, d)
-    return out[:, 0, :] if single else out
+    out = out[:, 0, :] if single else out
+    if return_stats:
+        # final ring-wide logsumexp per row, (H, Sq, 1) — the flash
+        # backward's recompute anchor
+        return out, (m + jnp.log(l))[..., None]
+    return out
 
 
 def softmax_attention(q, k, v, *, scale: float | None = None,
@@ -306,8 +388,9 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
     Materialises the full (H, S, T) score tensor — the local compute of
     :func:`ulysses_attention` and the oracle the ring variants are tested
     against. ``use_flash=True`` runs the Pallas flash kernel instead
-    (tiled, no (H, S, T) materialisation — forward-only, see
-    ``ops.pallas_attention``).
+    (tiled, no (H, S, T) materialisation) — DIFFERENTIABLE via the same
+    flash backward kernels as the ring path (one "ring step" with both
+    offsets 0), so Ulysses-flash trains at flash speed too.
     """
     d = q.shape[-1]
     if q.shape[1] % k.shape[1]:
@@ -317,18 +400,47 @@ def softmax_attention(q, k, v, *, scale: float | None = None,
         )
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if use_flash:
-        from tpu_distalg.ops.pallas_attention import flash_attention_block
-
-        qh = jnp.moveaxis(q, 1, 0)                    # (H, S, d)
-        h, s_q, _ = qh.shape
-        o, _, l = flash_attention_block(
-            qh, jnp.moveaxis(k, 1, 0), jnp.moveaxis(v, 1, 0),
-            jnp.zeros((h, s_q, d), jnp.float32),
-            jnp.full((h, s_q, 1), -jnp.inf, jnp.float32),
-            jnp.zeros((h, s_q, 1), jnp.float32),
-            0, 0, scale=s, causal=causal, interpret=flash_interpret,
+        from tpu_distalg.ops.pallas_attention import (
+            flash_attention_backward_block,
+            flash_attention_block,
         )
-        return jnp.moveaxis(o / l, 0, 1)
+
+        def _flash_fwd_stats(q_, k_, v_):
+            qh = jnp.moveaxis(q_, 1, 0)               # (H, S, d)
+            h, s_q, _ = qh.shape
+            o, m, l = flash_attention_block(
+                qh, jnp.moveaxis(k_, 1, 0), jnp.moveaxis(v_, 1, 0),
+                jnp.zeros((h, s_q, d), jnp.float32),
+                jnp.full((h, s_q, 1), -jnp.inf, jnp.float32),
+                jnp.zeros((h, s_q, 1), jnp.float32),
+                0, 0, scale=s, causal=causal, interpret=flash_interpret,
+            )
+            return jnp.moveaxis(o / l, 0, 1), m + jnp.log(l)
+
+        @jax.custom_vjp
+        def flash_fn(q_, k_, v_):
+            return _flash_fwd_stats(q_, k_, v_)[0]
+
+        def _fwd(q_, k_, v_):
+            out, lse = _flash_fwd_stats(q_, k_, v_)
+            return out, (q_, k_, v_, out, lse)
+
+        def _bwd(res, g):
+            q_, k_, v_, out, lse = res
+            doh = jnp.moveaxis(g, 1, 0).astype(jnp.float32)
+            oh = jnp.moveaxis(out, 1, 0).astype(jnp.float32)
+            delta = jnp.sum(doh * oh, axis=-1, keepdims=True)
+            dq, dk, dv = flash_attention_backward_block(
+                jnp.moveaxis(q_, 1, 0), jnp.moveaxis(k_, 1, 0),
+                jnp.moveaxis(v_, 1, 0), doh, lse, delta, 0, 0,
+                scale=s, causal=causal, interpret=flash_interpret,
+            )
+            return (jnp.moveaxis(dq, 0, 1).astype(q_.dtype),
+                    jnp.moveaxis(dk, 0, 1).astype(k_.dtype),
+                    jnp.moveaxis(dv, 0, 1).astype(v_.dtype))
+
+        flash_fn.defvjp(_fwd, _bwd)
+        return flash_fn(q, k, v)
     # grouped-query heads consumed through a zero-copy grouped einsum
     # view, like _online_update — no KV replication on any path
     s_q, h, _ = q.shape
@@ -360,9 +472,11 @@ def ulysses_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     are global, so ``causal`` needs no cross-shard bookkeeping), and the
     inverse exchange restores (S_local, H, d). Exact; requires H
     divisible by the axis size. ``use_flash=True`` runs the local
-    attention through the Pallas flash kernel (no full score tensor —
-    forward-only); otherwise peak memory is O(S²·H/n) — prefer
-    :func:`ring_attention` when that binds.
+    attention through the Pallas flash kernel (no full score tensor),
+    DIFFERENTIABLE via :func:`softmax_attention`'s flash VJP — the
+    cotangents flow back through the inverse exchanges; otherwise peak
+    memory is O(S²·H/n) — prefer :func:`ring_attention` when that
+    binds.
     """
     qh = alltoall_seq_to_head(q, axis_name)
     kh = alltoall_seq_to_head(k, axis_name)
