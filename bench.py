@@ -434,16 +434,27 @@ def _bench_pagerank(mesh, n_chips):
     el = gops.prepare_edges(edges, PR_VERTICES)
     de = pagerank.prepare_device_edges(el, mesh)
 
-    cfg = pagerank.PageRankConfig(
-        n_iterations=PR_ITERS_PER_CALL, mode="standard")
-    fn = pagerank.make_run_fn(mesh, cfg, de.n_vertices)
-
     from tpu_distalg.utils import profiling
 
-    best, spread = profiling.steps_per_sec(
-        lambda: fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
-                   de.n_ref),
-        steps=PR_ITERS_PER_CALL, repeats=N_REPEATS, with_stats=True)
+    # A/B both scatter paths: the Pallas windowed one-hot-MXU kernel
+    # (primary) against the XLA segment_sum it replaces — recorded the
+    # way ops/pallas_kmeans.py's negative result was, but this one wins
+    # (~1.8x, ops/pallas_pagerank.py docstring)
+    rates = {}
+    for scatter in ("pallas", "xla"):
+        if scatter == "pallas" and de.plan is None:
+            continue
+        cfg = pagerank.PageRankConfig(
+            n_iterations=PR_ITERS_PER_CALL, mode="standard",
+            scatter=scatter)
+        fn = pagerank.make_run_fn(mesh, cfg, de.n_vertices,
+                                  de.plan if scatter == "pallas" else None)
+        rates[scatter] = profiling.steps_per_sec(
+            lambda: fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
+                       de.n_ref),
+            steps=PR_ITERS_PER_CALL, repeats=N_REPEATS, with_stats=True)
+    primary = "pallas" if "pallas" in rates else "xla"
+    best, spread = rates[primary]
     per_chip = best / n_chips
 
     # measured baseline stand-in, as for SSGD: the reference's driver
@@ -462,18 +473,17 @@ def _bench_pagerank(mesh, n_chips):
                           de.has_out, de.n_ref)[0][:1])
     measured_baseline = n_base / (time.perf_counter() - t0)
 
-    # achieved PER-CHIP time per edge vs the documented XLA random-access
-    # floor (one random ranks[src] gather per edge per sweep at
-    # ~10-15 ns/elem through XLA on v5e — models/pagerank.py module
-    # docstring; the sorted scatter and the elementwise tail ride
-    # bandwidth, not latency, so the gather bounds the sweep). Edges are
-    # sharded over the data axis, so each chip gathers n_edges/n_shards
-    # per sweep — ×n_shards keeps the number comparable to the per-chip
-    # floor on multi-chip meshes.
+    # achieved PER-CHIP time per edge. The XLA sweep is bounded by its
+    # two random-access ops (~8 ns/elem each: ranks[src] gather + the
+    # segment_sum — models/pagerank.py docstring); the Pallas scatter
+    # removes one of them, leaving the gather as the floor. Edges are
+    # sharded over the data axis, so each chip sweeps n_edges/n_shards
+    # per iteration — ×n_shards keeps the number comparable on
+    # multi-chip meshes.
     n_shards = int(mesh.shape["data"])
     ns_per_edge = 1e9 * n_shards / (best * float(el.n_edges))
 
-    print(json.dumps({
+    out = {
         "metric": "pagerank_1m_iters_per_sec",
         "value": round(per_chip, 3),
         "unit": "iter/s/chip",
@@ -482,14 +492,23 @@ def _bench_pagerank(mesh, n_chips):
         "baseline_method": "jit-per-iteration host-roundtrip loop "
                            "(measured, the reference's job-per-iteration "
                            "driver shape)",
+        "scatter_path": primary,
         "ns_per_edge": round(ns_per_edge, 2),
-        "ns_per_edge_floor_documented": [10, 15],
         "n_vertices": PR_VERTICES,
         "n_edges": int(el.n_edges),
         "mode": "standard",
         "iters_per_call": PR_ITERS_PER_CALL,
         "spread": spread,
-    }), flush=True)
+    }
+    if "xla" in rates and primary != "xla":
+        xla_best, xla_spread = rates["xla"]
+        out["xla_scatter_iters_per_sec_per_chip"] = round(
+            xla_best / n_chips, 3)
+        out["xla_scatter_ns_per_edge"] = round(
+            1e9 * n_shards / (xla_best * float(el.n_edges)), 2)
+        out["xla_scatter_spread"] = xla_spread
+        out["pallas_vs_xla_scatter"] = round(best / xla_best, 2)
+    print(json.dumps(out), flush=True)
 
 
 def _bench_als(mesh, n_chips):

@@ -137,6 +137,11 @@ def main(argv=None):
     p.add_argument("--q", type=float, default=0.15)
     p.add_argument("--mode", default="reference",
                    choices=["reference", "standard"])
+    p.add_argument("--scatter", default="auto",
+                   choices=["auto", "pallas", "xla"],
+                   help="standard-mode scatter path: the Pallas windowed "
+                        "one-hot-MXU kernel (when the graph admits a "
+                        "window plan) or the XLA segment_sum")
     p.add_argument("--n-vertices", type=int, default=0,
                    help="0 = the reference's 4-edge toy graph; else an "
                         "Erdős–Rényi graph of this many vertices")
@@ -313,7 +318,8 @@ def _dispatch(args, jax):
             edges = datasets.erdos_renyi_edges(args.n_vertices)
         t0 = time.perf_counter()
         res = m.run(edges, _mesh(args), m.PageRankConfig(
-            n_iterations=args.n_iterations, q=args.q, mode=args.mode))
+            n_iterations=args.n_iterations, q=args.q, mode=args.mode,
+            scatter=args.scatter))
         jax.block_until_ready(res.ranks)
         dt = time.perf_counter() - t0
         import numpy as np

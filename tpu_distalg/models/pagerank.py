@@ -24,7 +24,18 @@ not bandwidth — bounds the sweep):
     sharding, and padding uses dst=V-1 (order-preserving, masked out).
     Rejected alternatives, measured no faster: pull/ELL in-edge tables
     (doubles the random accesses) and prefix-sum segmented reduction
-    (f32 prefix differences can't resolve 1e-6-scale ranks).
+    (f32 prefix differences can't resolve 1e-6-scale ranks);
+  * standard mode goes one further: dst-sortedness means consecutive
+    edges target a narrow band of a (V/128, 128) vertex table, so the
+    scatter becomes a Pallas kernel (``ops/pallas_pagerank``) that
+    keeps the table VMEM-resident and scatter-adds each 1024-edge
+    chunk with ONE one-hot MXU matmul — no random-access engine at
+    all. Measured: sweep drops ~17 → ~9.2 ns/edge (13.5 iter/s at
+    1M×8M on one v5e). The remaining random op, the ``ranks[src]``
+    gather, stays in XLA: a Pallas windowed gather is 4× faster in
+    isolation but needs src-sorted edges, and re-crossing the per-edge
+    array between sort orders costs exactly the random access it
+    saves (full analysis: ``ops/pallas_pagerank`` docstring).
 
 Two modes (SURVEY.md §7 hard part #6):
   * ``mode='reference'`` reproduces the reference's semantics exactly: n is
@@ -62,12 +73,26 @@ class PageRankConfig:
     q: float = 0.15
     mode: str = "reference"  # 'reference' | 'standard'
     redistribute_dangling: bool = True  # standard mode only
+    scatter: str = "auto"  # 'auto' | 'pallas' | 'xla' (standard mode)
 
 
 @dataclasses.dataclass
 class PageRankResult:
     ranks: jax.Array      # (V,) dense rank vector
     has_rank: jax.Array   # (V,) bool: vertex holds a rank (reference mode)
+
+
+@dataclasses.dataclass
+class DevicePlan:
+    """Device-resident :class:`ops.pallas_pagerank.ScatterPlan` arrays."""
+
+    base: jax.Array   # (NCH,) int32, sharded over data
+    row: jax.Array    # (NCH, chunk) int32
+    lane: jax.Array   # (NCH, chunk) int32
+    w: int
+    blk: int
+    r8: int
+    n_chunks: int
 
 
 @dataclasses.dataclass
@@ -82,12 +107,24 @@ class DeviceEdges:
     has_out: jax.Array  # (V,) f32
     n_vertices: int
     n_ref: float        # reference's n = #vertices with out-links (:41-44)
+    plan: DevicePlan | None = None  # Pallas scatter prep (standard mode)
 
 
-def prepare_device_edges(el: gops.EdgeList, mesh: Mesh) -> DeviceEdges:
+def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
+                         plan_chunk: int | None = None,
+                         plan_blk: int | None = None,
+                         build_plan: bool = True) -> DeviceEdges:
     """One-time host prep: dst-sort (native C++ counting sort), per-edge
-    weight gather, pad, shard."""
+    weight gather, pad, shard — plus the Pallas-scatter window plan
+    (``ops/pallas_pagerank.plan_scatter``) when the graph admits one.
+
+    When the plan succeeds, ALL edge arrays adopt its per-shard padding
+    (tail replicates each shard's last dst with zero weight/mask), so
+    the XLA fallback path and the Pallas path share the same arrays;
+    otherwise the legacy dst=V-1 tail padding is used.
+    """
     from tpu_distalg import native
+    from tpu_distalg.ops import pallas_pagerank as ppr
 
     order = native.counting_sort_perm(el.dst, el.n_vertices)
     src_o = el.src[order].astype(np.int32)
@@ -100,25 +137,60 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh) -> DeviceEdges:
     V = el.n_vertices
     n_shards = mesh.shape[DATA_AXIS]
     E = len(src_o)
-    n_pad = (-E) % n_shards
-    # padding keeps dst sorted (dst=V-1 ≥ every real id) and carries zero
-    # weight/mask, so sorted-segment-sum sees it as an inert tail
-    src_p = np.concatenate([src_o, np.zeros(n_pad, np.int32)])
-    dst_p = np.concatenate([dst_o, np.full(n_pad, V - 1, np.int32)])
-    w_p = np.concatenate([w_e, np.zeros(n_pad, np.float32)])
-    emask = np.ones(E + n_pad, np.float32)
-    emask[E:] = 0.0
     shard1 = data_sharding(mesh, 1)
     put = lambda a: jax.device_put(jnp.asarray(a), shard1)  # noqa: E731
     has_out = (deg > 0).astype(np.float32)
+
+    kw = {}
+    if plan_chunk is not None:
+        kw["chunk"] = plan_chunk
+    if plan_blk is not None:
+        kw["blk"] = plan_blk
+    plan = (ppr.plan_scatter(dst_o, V, n_shards, **kw)
+            if E and build_plan else None)
+    if plan is not None:
+        # per-shard tail padding, driven by the plan's OWN shard
+        # slicing (real_per_shard) so src/w/emask can never desync
+        # from the dst encoding in plan.row/plan.lane
+        sl = plan.shard_len
+        src_p = np.zeros(n_shards * sl, np.int32)
+        w_p = np.zeros(n_shards * sl, np.float32)
+        emask = np.zeros(n_shards * sl, np.float32)
+        lo = 0
+        for s, n_real in enumerate(plan.real_per_shard):
+            src_p[s * sl:s * sl + n_real] = src_o[lo:lo + n_real]
+            w_p[s * sl:s * sl + n_real] = w_e[lo:lo + n_real]
+            emask[s * sl:s * sl + n_real] = 1.0
+            lo += n_real
+        # the padded dst is exactly what the plan encoded
+        dst_p = (plan.row.reshape(-1) * 128 + plan.lane.reshape(-1)
+                 ).astype(np.int32)
+        shard2 = data_sharding(mesh, 2)
+        dplan = DevicePlan(
+            base=put(plan.base),
+            row=jax.device_put(jnp.asarray(plan.row), shard2),
+            lane=jax.device_put(jnp.asarray(plan.lane), shard2),
+            w=plan.w, blk=plan.blk, r8=plan.r8, n_chunks=plan.n_chunks,
+        )
+    else:
+        n_pad = (-E) % n_shards
+        # padding keeps dst sorted (dst=V-1 ≥ every real id) and carries
+        # zero weight/mask, so sorted-segment-sum sees an inert tail
+        src_p = np.concatenate([src_o, np.zeros(n_pad, np.int32)])
+        dst_p = np.concatenate([dst_o, np.full(n_pad, V - 1, np.int32)])
+        w_p = np.concatenate([w_e, np.zeros(n_pad, np.float32)])
+        emask = np.ones(E + n_pad, np.float32)
+        emask[E:] = 0.0
+        dplan = None
     return DeviceEdges(
         src=put(src_p), dst=put(dst_p), w_e=put(w_p), emask=put(emask),
         inv_deg=jnp.asarray(inv_deg), has_out=jnp.asarray(has_out),
-        n_vertices=V, n_ref=float(has_out.sum()),
+        n_vertices=V, n_ref=float(has_out.sum()), plan=dplan,
     )
 
 
-def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
+def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
+                plan: DevicePlan | None = None):
     """Build the jitted n-iteration sweep.
 
     PRECONDITION: the edge arrays passed to the returned ``run`` MUST be
@@ -127,9 +199,30 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
     ``indices_are_sorted=True`` to XLA, which is unchecked: unsorted
     ``dst`` yields silently wrong rank sums, not an error. Construct the
     inputs via :func:`prepare_device_edges` (or :func:`run`, which does).
+
+    Standard mode with a ``plan`` (and ``config.scatter`` 'auto'/'pallas')
+    runs the hybrid sweep: XLA does the one random op it is good at (the
+    fused ``ranks[src]·w`` gather) and the Pallas windowed one-hot-MXU
+    kernel (``ops/pallas_pagerank``) replaces the segment_sum — measured
+    ~9.2 ns/edge vs ~17 for the XLA-only sweep at 1M×8M on one v5e.
+    ``scatter='pallas'`` without a plan raises; 'xla' forces the legacy
+    path (benchmark A/B).
     """
     V = n_vertices
     q = config.q
+
+    if config.mode == "standard" and config.scatter not in (
+            "auto", "pallas", "xla"):
+        raise ValueError(f"unknown scatter mode {config.scatter!r}")
+    use_pallas = (config.mode == "standard" and config.scatter != "xla"
+                  and plan is not None)
+    if config.mode == "standard" and config.scatter == "pallas" \
+            and plan is None:
+        raise ValueError(
+            "scatter='pallas' needs a scatter plan — the graph's dst "
+            "distribution was too sparse/skewed for a bounded window "
+            "(ops/pallas_pagerank.plan_scatter returned None)"
+        )
 
     if config.mode == "reference":
         def body(src, dst, w_e, emask, ranks, has_rank):
@@ -167,8 +260,50 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int):
 
         return jax.jit(run)
 
-    # standard mode: every vertex ranked, Σranks preserved; one gather +
-    # one sorted scatter per iteration
+    if use_pallas:
+        from tpu_distalg.ops import pallas_pagerank as ppr
+
+        interpret = next(iter(mesh.devices.flat)).platform != "tpu"
+        w, r8, blk = plan.w, plan.r8, plan.blk
+        nch_local = plan.n_chunks // mesh.shape[DATA_AXIS]
+        chunk = plan.row.shape[1]
+
+        def body(src, w_e, base, row, lane, ranks):
+            g = (ranks[src] * w_e).reshape(nch_local, chunk)
+            acc = ppr.scatter_table(base, g, row, lane, w=w, r8=r8,
+                                    blk=blk, interpret=interpret)
+            return tree_allreduce_sum(acc)
+
+        sweep_fn = data_parallel(
+            body, mesh,
+            in_specs=(P("data"), P("data"), P("data"),
+                      P("data", None), P("data", None), P()),
+            out_specs=P(),
+        )
+
+        def run(src, dst, w_e, emask, has_out, n_ref):
+            del dst, emask, n_ref  # plan arrays encode the padded dst
+            ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+
+            def step(ranks, _):
+                acc = sweep_fn(src, w_e, plan.base, plan.row,
+                               plan.lane, ranks)
+                c = acc[:r8].reshape(-1)[:V]
+                if config.redistribute_dangling:
+                    dangling = jnp.sum(ranks * (1.0 - has_out))
+                    c = c + dangling / V
+                ranks = q / V + (1 - q) * c
+                return ranks, None
+
+            ranks, _ = jax.lax.scan(
+                step, ranks0, None, length=config.n_iterations
+            )
+            return ranks, jnp.ones((V,), dtype=jnp.float32)
+
+        return jax.jit(run)
+
+    # standard mode, XLA path: every vertex ranked, Σranks preserved;
+    # one gather + one sorted scatter per iteration
     def body(src, dst, w_e, ranks):
         c = gops.contribs(ranks, src, dst, w_e, V, indices_sorted=True)
         return tree_allreduce_sum(c)
@@ -203,8 +338,11 @@ def run(edges: np.ndarray, mesh: Mesh,
         config: PageRankConfig = PageRankConfig(),
         n_vertices: int | None = None) -> PageRankResult:
     el = gops.prepare_edges(edges, n_vertices)
-    de = prepare_device_edges(el, mesh)
-    fn = make_run_fn(mesh, config, de.n_vertices)
+    de = prepare_device_edges(
+        el, mesh,
+        build_plan=(config.mode == "standard"
+                    and config.scatter != "xla"))
+    fn = make_run_fn(mesh, config, de.n_vertices, de.plan)
     ranks, has_rank = fn(
         de.src, de.dst, de.w_e, de.emask, de.has_out, de.n_ref
     )
