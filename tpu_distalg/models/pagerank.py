@@ -211,9 +211,14 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
     V = n_vertices
     q = config.q
 
-    if config.mode == "standard" and config.scatter not in (
-            "auto", "pallas", "xla"):
+    if config.scatter not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown scatter mode {config.scatter!r}")
+    if config.mode != "standard" and config.scatter != "auto":
+        raise ValueError(
+            f"scatter={config.scatter!r} only applies to mode="
+            "'standard' — the reference-parity mode always uses the "
+            "XLA segment_sum path"
+        )
     use_pallas = (config.mode == "standard" and config.scatter != "xla"
                   and plan is not None)
     if config.mode == "standard" and config.scatter == "pallas" \

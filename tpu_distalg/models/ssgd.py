@@ -65,7 +65,11 @@ class SSGDConfig:
     # 'fused_train' = 'fused_gather' with the WHOLE schedule fused into
     # one kernel launch per mega_steps segment (weights live in VMEM,
     # update runs in-kernel): fastest path, but single-data-shard only
-    # (no per-step psum), lam=0 only, eval at segment boundaries only.
+    # (no per-step psum), lam=0 only, eval at segment boundaries only;
+    # 'virtual' = NO resident dataset: sampled blocks are regenerated
+    # on device from the counter-based row generator each step, so the
+    # logical row count is unbounded by HBM (build via
+    # models/ssgd_virtual.make_train_fn — the >HBM path).
     # Precision note: with x_dtype='bfloat16' the fused kernels cast the
     # residual AND the selector-replicated weights to bf16 (the XLA bf16
     # path keeps both f32) — a small extra deviation; convergence to the
