@@ -231,3 +231,70 @@ def test_segmented_with_eval_every(mesh8, data, tmp_path):
     np.testing.assert_array_equal(np.asarray(straight.w), np.asarray(seg.w))
     np.testing.assert_array_equal(
         np.asarray(straight.accs), np.asarray(seg.accs))
+
+
+def test_run_with_restarts_retries_then_succeeds():
+    """The watchdog core: transient failures re-run; the retry budget
+    is respected; success stops the loop."""
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    calls = {"n": 0}
+    logs = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("injected transient crash")
+        return "done"
+
+    assert ckpt.run_with_restarts(flaky, max_restarts=2,
+                                  logger=logs.append) == "done"
+    assert calls["n"] == 3 and len(logs) == 2
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        ckpt.run_with_restarts(flaky, max_restarts=1)
+
+    with pytest.raises(ValueError, match="max_restarts"):
+        ckpt.run_with_restarts(flaky, max_restarts=-1)
+
+
+def test_watchdog_recovers_bitwise_from_guard_trip(mesh8, data, tmp_path,
+                                                   monkeypatch):
+    """The verdict's failure-recovery scenario end-to-end: a NaN-guard
+    trip mid-run kills the job after segment 1 is checkpointed; the
+    auto-restart re-runs, resumes from step 40, and the recovered
+    weights and accuracy history are BITWISE equal to an uninterrupted
+    run (sampling keys on absolute step ids)."""
+    from tpu_distalg.utils import checkpoint as ckpt
+    from tpu_distalg.utils import metrics
+
+    X_train, y_train, X_test, y_test = data
+    cfg = ssgd.SSGDConfig(n_iterations=120)
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg)
+
+    real_guard = metrics.guard_finite
+    trips = {"armed": True}
+
+    def tripping_guard(tree, what):
+        real_guard(tree, what)
+        # simulate a non-finite state detected after the SECOND segment
+        # (step 80) of the first attempt — exactly once
+        if trips["armed"] and "step 80" in what:
+            trips["armed"] = False
+            raise FloatingPointError(f"injected NaN in {what}")
+
+    monkeypatch.setattr(metrics, "guard_finite", tripping_guard)
+
+    def run_once():
+        return ssgd.train(
+            X_train, y_train, X_test, y_test, mesh8, cfg,
+            checkpoint_dir=str(tmp_path / "wd"), checkpoint_every=40)
+
+    res = ckpt.run_with_restarts(run_once, max_restarts=1,
+                                 logger=lambda m: None)
+    assert not trips["armed"], "the injected guard trip never fired"
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(res.w))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(res.accs))

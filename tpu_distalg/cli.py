@@ -52,6 +52,11 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="segmented checkpoint/resume directory")
     p.add_argument("--checkpoint-every", type=int, default=500)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="auto-restart the run up to N times on crash or "
+                        "NaN-guard trip; with --checkpoint-dir each "
+                        "restart resumes from the latest checkpoint "
+                        "(bitwise-identical to an uninterrupted run)")
 
 
 def _report_optimizer(name, res, args, t):
@@ -222,10 +227,12 @@ def _dispatch(args, jax):
         if args.cmd == "lr":
             from tpu_distalg.models import logistic_regression as m
 
-            res = m.train(*data, mesh, m.LRConfig(
-                n_iterations=args.n_iterations, eta=args.eta),
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every)
+            def run_once():
+                return m.train(
+                    *data, mesh, m.LRConfig(
+                        n_iterations=args.n_iterations, eta=args.eta),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
         elif args.cmd == "ssgd":
             from tpu_distalg.models import ssgd as m
 
@@ -241,9 +248,11 @@ def _dispatch(args, jax):
                 # the megakernel evaluates at launch boundaries only
                 kw["eval_every"] = min(m.SSGDConfig().mega_steps,
                                        args.n_iterations)
-            res = m.train(*data, mesh, m.SSGDConfig(**kw),
-                          checkpoint_dir=args.checkpoint_dir,
-                          checkpoint_every=args.checkpoint_every)
+            def run_once():
+                return m.train(
+                    *data, mesh, m.SSGDConfig(**kw),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
         else:
             mod = {
                 "ma": "MAConfig", "bmuf": "BMUFConfig", "easgd": "EASGDConfig"
@@ -252,17 +261,26 @@ def _dispatch(args, jax):
 
             m = importlib.import_module(f"tpu_distalg.models.{args.cmd}")
             cfg_cls = getattr(m, mod[args.cmd])
-            res = m.train(*data, mesh, cfg_cls(
-                n_iterations=args.n_iterations, eta=args.eta,
-                mini_batch_fraction=args.mini_batch_fraction,
-                n_local_iterations=args.n_local_iterations,
-                resample_per_local_step=args.resample_per_local_step,
-                sampler=args.sampler, x_dtype=args.x_dtype,
-                gather_block_rows=args.gather_block_rows,
-                fused_pack=args.fused_pack,
-                shuffle_seed=args.shuffle_seed),
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every)
+            def run_once(m=m, cfg_cls=cfg_cls):
+                return m.train(
+                    *data, mesh, cfg_cls(
+                        n_iterations=args.n_iterations, eta=args.eta,
+                        mini_batch_fraction=args.mini_batch_fraction,
+                        n_local_iterations=args.n_local_iterations,
+                        resample_per_local_step=(
+                            args.resample_per_local_step),
+                        sampler=args.sampler, x_dtype=args.x_dtype,
+                        gather_block_rows=args.gather_block_rows,
+                        fused_pack=args.fused_pack,
+                        shuffle_seed=args.shuffle_seed),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        # the watchdog: crash / NaN-guard trips re-run the job, which
+        # resumes from the newest checkpoint (utils/checkpoint.py)
+        res = ckpt.run_with_restarts(
+            run_once, max_restarts=args.max_restarts)
         jax.block_until_ready(res.w)
         _report_optimizer(args.cmd, res, args, time.perf_counter() - t0)
 

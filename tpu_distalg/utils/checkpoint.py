@@ -167,6 +167,48 @@ def run_segmented(
     return state, accs, start
 
 
+def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
+    """Job-level auto-restart: the task-retry analogue of what Spark
+    gives the reference silently (task retry + lineage recomputation —
+    e.g. the cached RDD at ``/root/reference/optimization/ssgd.py:86``
+    is rebuilt by lineage if an executor dies; SURVEY.md §5 "failure
+    detection").
+
+    ``run_once()`` is invoked up to ``1 + max_restarts`` times; any
+    ``Exception`` (a device/tunnel crash, or :func:`run_segmented`'s
+    non-finite-state guard trip) triggers a retry. Recovery comes from
+    pairing with a ``checkpoint_dir``: every workload's segmented
+    runner resumes from the newest checkpoint on disk, so a retry
+    replays only the failed segment — and because segment sampling is
+    keyed on absolute step ids, the recovered run is bitwise-identical
+    to an uninterrupted one. Without a checkpoint dir each retry
+    starts from step 0 (still useful for transient device faults).
+    Deterministic failures (a genuine NaN the guard keeps re-hitting)
+    exhaust the retries and re-raise the LAST error. Configuration
+    errors (``ValueError``/``TypeError``/``FileNotFoundError`` — e.g.
+    an incompatible checkpoint directory) fail identically every time,
+    so they are never retried; ``KeyboardInterrupt``/``SystemExit``
+    are never caught.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    attempt = 0
+    while True:
+        try:
+            return run_once()
+        except (ValueError, TypeError, FileNotFoundError):
+            raise  # deterministic config error — retrying cannot help
+        except Exception as e:  # noqa: BLE001 — anything restartable
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            (logger or print)(
+                f"[restart {attempt}/{max_restarts}] "
+                f"{type(e).__name__}: {e} — re-running (resumes from "
+                f"the latest checkpoint if one exists)"
+            )
+
+
 def prune(ckpt_dir: str, keep: int = 3) -> None:
     """Delete all but the newest ``keep`` checkpoints."""
     if not os.path.isdir(ckpt_dir):
