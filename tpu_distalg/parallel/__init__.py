@@ -39,6 +39,8 @@ from tpu_distalg.parallel.ring import (
     ring_attention,
     softmax_attention,
     ulysses_attention,
+    zigzag_inverse,
+    zigzag_order,
 )
 
 __all__ = [
@@ -68,4 +70,6 @@ __all__ = [
     "tree_allreduce_mean",
     "tree_allreduce_sum",
     "ulysses_attention",
+    "zigzag_inverse",
+    "zigzag_order",
 ]

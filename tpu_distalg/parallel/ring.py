@@ -112,6 +112,39 @@ def _online_update(qh, o, m, l, kh, vh, scale, mask):
     return o, m_new, l
 
 
+def zigzag_order(n_shards: int, n_rows: int):
+    """Row permutation for the balanced causal ring layout: lay a
+    global (S, ...) array out as ``x[zigzag_order(n, S)]`` and shard it
+    over the ring; shard s then holds global chunks (s, 2n−1−s) — the
+    position↔shard map :func:`ring_attention` ``layout='zigzag'``
+    expects. ``S`` must divide into 2n equal chunks."""
+    if n_rows % (2 * n_shards):
+        raise ValueError(
+            f"zigzag_order: {n_rows} rows not divisible by "
+            f"2·n_shards={2 * n_shards}"
+        )
+    import numpy as np
+
+    c = n_rows // (2 * n_shards)
+    parts = []
+    for s in range(n_shards):
+        parts.append(np.arange(s * c, (s + 1) * c))
+        parts.append(np.arange((2 * n_shards - 1 - s) * c,
+                               (2 * n_shards - s) * c))
+    return np.concatenate(parts)
+
+
+def zigzag_inverse(n_shards: int, n_rows: int):
+    """Inverse permutation: ``out[zigzag_order] = zigzag_out`` →
+    ``zigzag_out[zigzag_inverse]`` is in natural position order."""
+    import numpy as np
+
+    p = zigzag_order(n_shards, n_rows)
+    inv = np.empty_like(p)
+    inv[p] = np.arange(len(p))
+    return inv
+
+
 def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
                    scale: float | None = None,
                    kv_chunk: int | None = None,
@@ -119,7 +152,8 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
                    use_flash: bool = False,
                    flash_interpret: bool = False,
                    flash_block_q: int = 2048,
-                   flash_block_kv: int = 2048):
+                   flash_block_kv: int = 2048,
+                   layout: str = "contiguous"):
     """Exact attention over a sequence sharded around the ring.
 
     ``q, k, v``: (S_local, d) single-head or (S_local, H, d) multi-head
@@ -133,11 +167,20 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     p attends to keys ≤ p. Blocks that arrive from a later shard are
     fully masked and skipped outright (``lax.cond`` around the compute —
     the ppermute still runs, keeping the ring in lockstep). The skip
-    saves the FLOPs but not the wall-clock imbalance (shard n−1 computes
-    n partial blocks while shard 0 computes 1); a zigzag/striped
-    placement would rebalance it and is intentionally not done here —
-    it changes the position↔shard map that every caller lays data
-    out with.
+    saves the FLOPs but not the wall-clock imbalance: shard n−1 computes
+    n partial blocks while shard 0 computes 1, idling ~half the ring's
+    FLOP capacity at n=8. ``layout='zigzag'`` fixes that: each shard
+    holds global chunks (s, 2n−1−s) — lay data out with
+    :func:`zigzag_order` / undo with :func:`zigzag_inverse` — and each
+    ring step decomposes into chunk-pairs of which ONE is statically
+    all-attend, one statically skipped, and two conditional, so every
+    shard computes exactly 2n+1 chunk-pair tiles (≈2n·c² FLOPs, c the
+    half-chunk length) per pass REGARDLESS of position — vs the
+    contiguous layout's shard-dependent 1…n full blocks (the striped/
+    zigzag context-parallel schedule; cf. llama-3-style zigzag
+    sharding). Zigzag requires ``causal=True`` (balanced already when
+    non-causal), even local length, and supersedes ``kv_chunk`` (use
+    flash blocks to bound memory).
 
     ``kv_chunk`` bounds the materialised score tile: the resident K/V
     block is processed in flash-attention-style chunks of that many keys
@@ -163,6 +206,24 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
     accumulation as differentiating the XLA path, so the gradients are
     exact. Set ``flash_interpret=True`` on CPU meshes (tests).
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "layout='zigzag' exists to balance the CAUSAL ring; "
+                "non-causal rings are balanced already"
+            )
+        if kv_chunk is not None:
+            raise ValueError(
+                "layout='zigzag' does not compose with kv_chunk; use "
+                "use_flash=True (tiled in VMEM) to bound memory"
+            )
+        return _ring_attention_zigzag(
+            q, k, v, axis_name=axis_name, scale=scale,
+            use_flash=use_flash, flash_interpret=flash_interpret,
+            bq=flash_block_q, bkv=flash_block_kv,
+        )
     if use_flash:
         bwd_bq = min(flash_block_q, 1024)
         bwd_bkv = min(flash_block_kv, 1024)
@@ -264,6 +325,219 @@ def _ring_flash_backward(q, k, v, out, lse, g, *, axis_name, scale,
         (kh0, vh0, zeros(kh0.shape), zeros(vh0.shape),
          zeros((h, s_q, d))),
     )
+    dq = jnp.moveaxis(dq, 0, 1).astype(q.dtype)
+    dk = jnp.moveaxis(dk, 0, 1).astype(k.dtype)
+    dv = jnp.moveaxis(dv, 0, 1).astype(v.dtype)
+    if single:
+        dq, dk, dv = (x[:, 0, :] for x in (dq, dk, dv))
+    return dq, dk, dv
+
+
+def _ring_attention_zigzag(q, k, v, *, axis_name, scale, use_flash,
+                           flash_interpret, bq, bkv):
+    if not use_flash:
+        return _zigzag_impl(
+            q, k, v, axis_name=axis_name, scale=scale, use_flash=False,
+            flash_interpret=flash_interpret, bq=bq, bkv=bkv)
+    impl = functools.partial(
+        _zigzag_impl, axis_name=axis_name, scale=scale,
+        flash_interpret=flash_interpret, bq=bq, bkv=bkv)
+
+    @jax.custom_vjp
+    def flash_fn(q, k, v):
+        return impl(q, k, v, use_flash=True)
+
+    def _fwd(q, k, v):
+        out, lse = impl(q, k, v, use_flash=True, return_stats=True)
+        return out, (q, k, v, out, lse)
+
+    def _bwd(res, g):
+        qq, kk, vv, out, lse = res
+        return _zigzag_flash_backward(
+            qq, kk, vv, out, lse, g, axis_name=axis_name, scale=scale,
+            flash_interpret=flash_interpret,
+            bq=min(bq, 1024), bkv=min(bkv, 1024))
+
+    flash_fn.defvjp(_fwd, _bwd)
+    return flash_fn(q, k, v)
+
+
+def _zigzag_pairs(my, src, n, c):
+    """Global start offsets of the per-step chunk-pairs.
+
+    Shard s holds q/k chunks (s, 2n−1−s) of c rows each. Of the four
+    (q-chunk, kv-chunk) pairs per ring step, (C,B) is STATICALLY all-
+    masked (C = my ≤ n−1 < B = 2n−1−src) and (D,A) STATICALLY all-
+    attend (D = 2n−1−my ≥ n > A = src), leaving two conditional pairs.
+    Per full pass shard ``my`` computes n unconditional (D,A) pairs,
+    my+1 (C,A) pairs and n−my (D,B) pairs = 2n+1 c²-tiles for EVERY
+    shard (≈2n·c² FLOPs after the triangular pairs' tile skip) — the
+    balance the contiguous layout lacks.
+    """
+    qc0 = my * c
+    qd0 = (2 * n - 1 - my) * c
+    ka0 = src * c
+    kb0 = (2 * n - 1 - src) * c
+    return qc0, qd0, ka0, kb0
+
+
+def _zigzag_impl(q, k, v, *, axis_name, scale, use_flash,
+                 flash_interpret, bq, bkv, return_stats=False):
+    single = q.ndim == 2
+    if single:
+        q, k, v = (x[:, None, :] for x in (q, k, v))
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_q, h, d = q.shape
+    if s_q % 2 or k.shape[0] != s_q:
+        raise ValueError(
+            f"zigzag ring: local length {s_q} must be even (two "
+            f"chunks) and q/k lengths equal (got k {k.shape[0]})"
+        )
+    if h % k.shape[1]:
+        raise ValueError(
+            f"ring_attention: {h} query heads not divisible by "
+            f"{k.shape[1]} KV heads"
+        )
+    c = s_q // 2
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.moveaxis(q, 1, 0)                     # (H, 2c, d)
+    qhC, qhD = qh[:, :c], qh[:, c:]
+
+    if use_flash:
+        from tpu_distalg.ops.pallas_attention import flash_attention_block
+
+        def upd(qc, kc, vc, st, q0, k0, causal_pair):
+            o, m, l = st
+            o, m, l = flash_attention_block(
+                qc, kc, vc, o, m[..., None], l[..., None], q0, k0,
+                scale=s, causal=causal_pair, bq=bq, bkv=bkv,
+                interpret=flash_interpret)
+            return o, m[..., 0], l[..., 0]
+    else:
+        def upd(qc, kc, vc, st, q0, k0, causal_pair):
+            mask = None
+            if causal_pair:
+                mask = ((q0 + jnp.arange(c))[:, None]
+                        >= (k0 + jnp.arange(c))[None, :])
+            return _online_update(qc, *st, kc, vc, s, mask)
+
+    def body(i, carry):
+        kh, vh, stC, stD = carry
+        src = (my - i) % n
+        qc0, qd0, ka0, kb0 = _zigzag_pairs(my, src, n, c)
+        kA, vA = kh[:, :c], vh[:, :c]
+        kB, vB = kh[:, c:], vh[:, c:]
+        stC = lax.cond(
+            src <= my,
+            lambda st: upd(qhC, kA, vA, st, qc0, ka0, True),
+            lambda st: st, stC)
+        stD = upd(qhD, kA, vA, stD, qd0, ka0, False)
+        stD = lax.cond(
+            src >= my,
+            lambda st: upd(qhD, kB, vB, st, qd0, kb0, True),
+            lambda st: st, stD)
+        perm = _ring_perm(n)
+        return (lax.ppermute(kh, axis_name, perm),
+                lax.ppermute(vh, axis_name, perm), stC, stD)
+
+    def st0():
+        return (jnp.zeros((h, c, d), jnp.float32),
+                jnp.full((h, c), -jnp.inf, jnp.float32),
+                jnp.zeros((h, c), jnp.float32))
+
+    kh0 = jnp.moveaxis(k, 1, 0)
+    vh0 = jnp.moveaxis(v, 1, 0)
+    _, _, (oC, mC, lC), (oD, mD, lD) = lax.fori_loop(
+        0, n, body, (kh0, vh0, st0(), st0()))
+    o = jnp.concatenate([oC / lC[..., None], oD / lD[..., None]],
+                        axis=1)
+    out = jnp.moveaxis(o, 0, 1)                    # (2c, H, d)
+    out = out[:, 0, :] if single else out
+    if return_stats:
+        lse = jnp.concatenate(
+            [mC + jnp.log(lC), mD + jnp.log(lD)], axis=1)[..., None]
+        return out, lse
+    return out
+
+
+def _zigzag_flash_backward(q, k, v, out, lse, g, *, axis_name, scale,
+                           flash_interpret, bq, bkv):
+    """Zigzag mirror of :func:`_ring_flash_backward`: the same three
+    live chunk-pairs per step, dK/dV accumulators rotating with their
+    blocks, dQ accumulating per local chunk."""
+    from tpu_distalg.ops.pallas_attention import (
+        flash_attention_backward_block,
+    )
+
+    single = q.ndim == 2
+    if single:
+        q, k, v, out, g = (x[:, None, :] for x in (q, k, v, out, g))
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_q, h, d = q.shape
+    c = s_q // 2
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.moveaxis(q, 1, 0)
+    kh0 = jnp.moveaxis(k, 1, 0)
+    vh0 = jnp.moveaxis(v, 1, 0)
+    doh = jnp.moveaxis(g, 1, 0).astype(jnp.float32)
+    oh = jnp.moveaxis(out, 1, 0).astype(jnp.float32)
+    delta = jnp.sum(doh * oh, axis=-1, keepdims=True)  # (H, 2c, 1)
+    qhC, qhD = qh[:, :c], qh[:, c:]
+    doC, doD = doh[:, :c], doh[:, c:]
+    lseC, lseD = lse[:, :c], lse[:, c:]
+    dC, dD = delta[:, :c], delta[:, c:]
+
+    def pair_bwd(qc, kc, vc, do_c, lse_c, delta_c, q0, k0, causal_pair):
+        return flash_attention_backward_block(
+            qc, kc, vc, do_c, lse_c, delta_c, q0, k0, scale=s,
+            causal=causal_pair, bq=bq, bkv=bkv,
+            interpret=flash_interpret)
+
+    def body(i, carry):
+        kh, vh, dk, dv, dqC, dqD = carry
+        src = (my - i) % n
+        qc0, qd0, ka0, kb0 = _zigzag_pairs(my, src, n, c)
+        kA, vA = kh[:, :c], vh[:, :c]
+        kB, vB = kh[:, c:], vh[:, c:]
+
+        def ca(args):
+            dqC, dk, dv = args
+            dq_c, dk_c, dv_c = pair_bwd(qhC, kA, vA, doC, lseC, dC,
+                                        qc0, ka0, True)
+            return (dqC + dq_c, dk.at[:, :c].add(dk_c),
+                    dv.at[:, :c].add(dv_c))
+
+        dqC, dk, dv = lax.cond(
+            src <= my, ca, lambda a: a, (dqC, dk, dv))
+        dq_c, dk_c, dv_c = pair_bwd(qhD, kA, vA, doD, lseD, dD,
+                                    qd0, ka0, False)
+        dqD = dqD + dq_c
+        dk = dk.at[:, :c].add(dk_c)
+        dv = dv.at[:, :c].add(dv_c)
+
+        def db(args):
+            dqD, dk, dv = args
+            dq_c, dk_c, dv_c = pair_bwd(qhD, kB, vB, doD, lseD, dD,
+                                        qd0, kb0, True)
+            return (dqD + dq_c, dk.at[:, c:].add(dk_c),
+                    dv.at[:, c:].add(dv_c))
+
+        dqD, dk, dv = lax.cond(
+            src >= my, db, lambda a: a, (dqD, dk, dv))
+        perm = _ring_perm(n)
+        return (lax.ppermute(kh, axis_name, perm),
+                lax.ppermute(vh, axis_name, perm),
+                lax.ppermute(dk, axis_name, perm),
+                lax.ppermute(dv, axis_name, perm), dqC, dqD)
+
+    zeros = functools.partial(jnp.zeros, dtype=jnp.float32)
+    _, _, dk, dv, dqC, dqD = lax.fori_loop(
+        0, n, body,
+        (kh0, vh0, zeros(kh0.shape), zeros(vh0.shape),
+         zeros((h, c, d)), zeros((h, c, d))))
+    dq = jnp.concatenate([dqC, dqD], axis=1)
     dq = jnp.moveaxis(dq, 0, 1).astype(q.dtype)
     dk = jnp.moveaxis(dk, 0, 1).astype(k.dtype)
     dv = jnp.moveaxis(dv, 0, 1).astype(v.dtype)
