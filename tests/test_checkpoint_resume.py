@@ -298,3 +298,31 @@ def test_watchdog_recovers_bitwise_from_guard_trip(mesh8, data, tmp_path,
                                   np.asarray(res.w))
     np.testing.assert_array_equal(np.asarray(straight.accs),
                                   np.asarray(res.accs))
+
+
+def test_fused_train_segment_guard_catches_all_segment_lengths(data):
+    """Advisor r3: eval_test=True with checkpoint_every not a multiple
+    of mega_steps used to raise the builder's 'segment boundaries'
+    error MID-RUN; the guard must fire up front — including for the
+    remainder segment. (fused_train is dp=1-only, so a 1-shard mesh.)"""
+    from tpu_distalg.parallel import get_mesh
+
+    mesh1 = get_mesh(data=1)
+    X_train, y_train, X_test, y_test = data
+    cfg = ssgd.SSGDConfig(n_iterations=500, sampler="fused_train",
+                          mega_steps=125, eval_every=125,
+                          fused_pack=4, gather_block_rows=32,
+                          shuffle_seed=0)
+    # checkpoint_every < mega_steps with eval_test: segment mega=100
+    # != eval_every=125 -> up-front error
+    with pytest.raises(ValueError, match="launch boundary"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg,
+                   checkpoint_dir="/tmp/unused_guard_a",
+                   checkpoint_every=100)
+    # full length is valid (500 % 125 == 0) but the segment is not:
+    # checkpoint_every=300 -> segment mega=125 doesn't divide 300 —
+    # must fail up front, not at the second segment build mid-run
+    with pytest.raises(ValueError, match="not divisible by mega_steps"):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg,
+                   checkpoint_dir="/tmp/unused_guard_b",
+                   checkpoint_every=300)

@@ -113,3 +113,17 @@ def test_fused_fit_converge_mode(mesh8):
     got = np.asarray(centers)[np.argsort(np.asarray(centers)[:, 0])]
     np.testing.assert_allclose(got[0], pts[:n // 2].mean(0), atol=0.1)
     np.testing.assert_allclose(got[1], pts[n // 2:].mean(0), atol=0.1)
+
+
+def test_packed_geometry_rejects_vmem_blowing_k():
+    """Advisor r3: k=256 with dim<=8 builds ~512 MB of butterfly
+    permutation constants — must be a clear up-front error, not a
+    Mosaic allocation failure."""
+    import pytest
+
+    from tpu_distalg.ops import pallas_kmeans as pk
+
+    with pytest.raises(ValueError, match="VMEM budget"):
+        pk.packed_geometry(8, 256)
+    # modest geometries still pass
+    pk.packed_geometry(16, 8)

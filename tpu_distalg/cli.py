@@ -46,6 +46,11 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
         p.add_argument("--gather-block-rows", type=int, default=1024)
         p.add_argument("--fused-pack", type=int, default=16)
         p.add_argument("--shuffle-seed", type=int, default=None)
+        p.add_argument("--mega-steps", type=int, default=None,
+                       help="steps per megakernel launch "
+                            "(sampler=fused_train); default auto-picks "
+                            "the largest divisor of --n-iterations "
+                            "<= 125 so any iteration count works")
     p.add_argument("--plot", type=str, default=None,
                    help="save an accuracy plot PNG here")
     p.add_argument("--quiet", action="store_true")
@@ -244,10 +249,42 @@ def _dispatch(args, jax):
                 gather_block_rows=args.gather_block_rows,
                 fused_pack=args.fused_pack,
                 shuffle_seed=args.shuffle_seed)
+            if args.sampler != "fused_train" and \
+                    args.mega_steps is not None:
+                raise SystemExit(
+                    f"--mega-steps applies to sampler=fused_train "
+                    f"only (got {args.sampler})"
+                )
             if args.sampler == "fused_train":
+                mega = args.mega_steps
+                if mega is None:
+                    # auto-pick: largest divisor of EVERY segment the
+                    # run will execute (checkpoint segments, remainder,
+                    # resume offset included) within the default launch
+                    # size — e.g. 300 iterations picks 100 instead of
+                    # failing the divisibility check at trace time
+                    import math
+
+                    segs = m.fused_train_segment_lengths(
+                        args.checkpoint_dir,
+                        (args.checkpoint_every if args.checkpoint_dir
+                         else args.n_iterations),
+                        args.n_iterations)
+                    g = math.gcd(*segs) if segs else args.n_iterations
+                    cap = min(m.SSGDConfig().mega_steps, g)
+                    mega = max(d for d in range(1, cap + 1)
+                               if g % d == 0)
+                    if mega < min(m.SSGDConfig().mega_steps,
+                                  args.n_iterations) // 2:
+                        print(
+                            f"[ssgd] note: auto-picked mega_steps="
+                            f"{mega} is far below the default launch "
+                            f"size — iteration/checkpoint counts with "
+                            f"a larger common divisor run faster"
+                        )
+                kw["mega_steps"] = mega
                 # the megakernel evaluates at launch boundaries only
-                kw["eval_every"] = min(m.SSGDConfig().mega_steps,
-                                       args.n_iterations)
+                kw["eval_every"] = min(mega, args.n_iterations)
             def run_once():
                 return m.train(
                     *data, mesh, m.SSGDConfig(**kw),
@@ -261,6 +298,12 @@ def _dispatch(args, jax):
 
             m = importlib.import_module(f"tpu_distalg.models.{args.cmd}")
             cfg_cls = getattr(m, mod[args.cmd])
+            if args.mega_steps is not None:
+                raise SystemExit(
+                    f"{args.cmd}: --mega-steps applies to ssgd only — "
+                    "local-update megakernels launch n-local-iterations "
+                    "steps per round"
+                )
             def run_once(m=m, cfg_cls=cfg_cls):
                 return m.train(
                     *data, mesh, cfg_cls(

@@ -654,6 +654,25 @@ def _make_train_fn_fixed(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, grad_fn)
 
 
+def fused_train_segment_lengths(checkpoint_dir, checkpoint_every: int,
+                                n_iterations: int) -> set[int]:
+    """The distinct compiled-segment lengths a checkpointed run will
+    execute, INCLUDING a resume from whatever step is on disk — shared
+    by the up-front fused_train guard and the CLI's mega_steps
+    auto-pick so both validate the lengths that will actually run."""
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    start = (ckpt.latest_step(checkpoint_dir) or 0) if checkpoint_dir \
+        else 0
+    lens: set[int] = set()
+    t = min(start, n_iterations)
+    while t < n_iterations:
+        seg = min(checkpoint_every, n_iterations - t)
+        lens.add(seg)
+        t += seg
+    return lens
+
+
 def _acc_carrying_run_seg(*data_args, w_sharding=None):
     """Segment runner shared by the XLA, fused and fused-tp checkpoint
     paths: state = (w, last_acc); the final emitted accuracy IS the
@@ -818,7 +837,7 @@ def prepare_fused_synthetic(
     import numpy as np
 
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding
 
     from tpu_distalg.ops import pallas_kernels
@@ -958,19 +977,37 @@ def _train_fused(
         metrics.guard_finite(w, "SSGD (fused) weights")
         return TrainResult(w=w[:d_orig], accs=accs)
 
-    if (config.sampler == "fused_train"
-            and checkpoint_every > config.mega_steps
-            and checkpoint_every % config.mega_steps):
-        # each checkpoint segment re-enters _make_train_fn_mega with
-        # n_iterations=segment length; segments shorter than mega_steps
-        # degrade to one launch, longer ones must hold whole launches
-        raise ValueError(
-            f"checkpoint_every ({checkpoint_every}) must be a multiple "
-            f"of mega_steps ({config.mega_steps}) for "
-            "sampler='fused_train'"
-        )
-
     from tpu_distalg.utils import checkpoint as ckpt
+
+    if config.sampler == "fused_train":
+        # each checkpoint segment re-enters _make_train_fn_mega with
+        # n_iterations=segment length and mega=min(mega_steps, segment):
+        # validate EVERY segment length up front — including those of a
+        # RESUMED run (start from the newest checkpoint, which may not
+        # be a multiple of the current checkpoint_every) — so a run
+        # cannot die mid-way on the builder's divisibility /
+        # eval-boundary checks after hours of training
+        for seg in sorted(fused_train_segment_lengths(
+                checkpoint_dir, checkpoint_every, config.n_iterations)):
+            mega = min(config.mega_steps, seg)
+            if seg % mega:
+                raise ValueError(
+                    f"sampler='fused_train': checkpoint segment of "
+                    f"{seg} steps is not divisible by mega_steps "
+                    f"({config.mega_steps}); choose checkpoint_every "
+                    f"and n_iterations as multiples of mega_steps"
+                )
+            if config.eval_test and config.eval_every != mega:
+                raise ValueError(
+                    f"sampler='fused_train' with eval_test: a "
+                    f"checkpoint segment of {seg} steps evaluates at "
+                    f"its launch boundary mega=min(mega_steps, seg)="
+                    f"{mega}, but eval_every={config.eval_every} — "
+                    f"make n_iterations and checkpoint_every multiples "
+                    f"of mega_steps (so no short remainder segment "
+                    f"exists) and set eval_every == mega_steps, or "
+                    f"eval_test=False"
+                )
 
     (w, _), accs, _ = ckpt.run_segmented(
         checkpoint_dir, checkpoint_every, config.n_iterations,

@@ -48,7 +48,10 @@ def cluster_stats(
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-        return sums, jnp.sum(om, axis=0)
+        # counts reduce in f32 regardless of points.dtype: a bf16 sum
+        # loses integer exactness past 2^8, and f32 past 2^24 rows is
+        # still exact for any realistic shard
+        return sums, jnp.sum(om.astype(jnp.float32), axis=0)
     weighted = points * mask[:, None]
     sums = jax.ops.segment_sum(weighted, assign, num_segments=k)
     counts = jax.ops.segment_sum(mask, assign, num_segments=k)

@@ -72,6 +72,20 @@ def packed_geometry(dim: int, k: int):
     k_pad = 1
     while k_pad < k:
         k_pad *= 2
+    # the butterfly's shift permutations are (log2 k_pad, L, L) f32
+    # constants resident in VMEM — at k=256 with dim<=8 (L=4096) that
+    # is ~512 MB, far over the ~100 MB VMEM budget, and would die
+    # inside Mosaic with an opaque allocation error; refuse up front
+    lanes = pp * k_pad
+    n_shifts = max(1, k_pad.bit_length() - 1)
+    perm_bytes = n_shifts * lanes * lanes * 4
+    if perm_bytes > 64 * 1024 * 1024:
+        raise ValueError(
+            f"pallas k-means geometry k={k}, dim={dim} needs "
+            f"{perm_bytes >> 20} MB of butterfly permutations "
+            f"({n_shifts}×{lanes}×{lanes} f32) — over the VMEM budget; "
+            "use the XLA path (ops.kmeans.cluster_stats)"
+        )
     return dpad, pp, k_pad
 
 
@@ -123,8 +137,9 @@ def _kernel(x_ref, xm_ref, csel_ref, cn2_ref, esel_ref, vsel_ref,
 
     # in-group butterfly min: after log2(k_pad) cyclic-shift rounds
     # (shift = permutation matmul — bf16-grid values and class ids
-    # <= 127 pass through exactly) every lane of a slot holds
-    # (min d, first-min class)
+    # < 256 pass through exactly: bf16's 8 mantissa bits represent
+    # every integer up to 2^8, matching the k <= 256 guard) every lane
+    # of a slot holds (min d, first-min class)
     for s in range(n_shifts):
         sh = shs_ref[s]                                # (L, L)
         ds = jnp.dot(d, sh, preferred_element_type=jnp.float32)
