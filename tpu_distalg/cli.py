@@ -257,7 +257,12 @@ def _dispatch(args, jax):
                 )
             if args.sampler == "fused_train":
                 mega = args.mega_steps
-                if mega is None:
+                if mega is not None and mega < 1:
+                    raise SystemExit(
+                        f"--mega-steps must be >= 1 (got {mega})")
+                if mega is None and args.n_iterations < 1:
+                    mega = m.SSGDConfig().mega_steps  # nothing to run
+                elif mega is None:
                     # auto-pick: largest divisor of EVERY segment the
                     # run will execute (checkpoint segments, remainder,
                     # resume offset included) within the default launch
@@ -284,7 +289,9 @@ def _dispatch(args, jax):
                         )
                 kw["mega_steps"] = mega
                 # the megakernel evaluates at launch boundaries only
-                kw["eval_every"] = min(mega, args.n_iterations)
+                # (max guards the degenerate n_iterations=0 run)
+                kw["eval_every"] = max(1, min(mega, args.n_iterations))
+
             def run_once():
                 return m.train(
                     *data, mesh, m.SSGDConfig(**kw),

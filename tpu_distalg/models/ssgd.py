@@ -403,6 +403,10 @@ def _make_train_fn_mega(mesh: Mesh, config: SSGDConfig, meta: dict,
             "default, ssgd.py:21); use 'fused_gather' for regularized "
             "runs"
         )
+    if config.mega_steps < 1:
+        raise ValueError(
+            f"mega_steps must be >= 1, got {config.mega_steps}"
+        )
     T = config.n_iterations
     mega = min(config.mega_steps, T)
     if T % mega:
@@ -662,6 +666,12 @@ def fused_train_segment_lengths(checkpoint_dir, checkpoint_every: int,
     auto-pick so both validate the lengths that will actually run."""
     from tpu_distalg.utils import checkpoint as ckpt
 
+    if checkpoint_every < 1:
+        # run_segmented raises the same downstream; failing here keeps
+        # the while loop below from spinning on a zero-length segment
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
     start = (ckpt.latest_step(checkpoint_dir) or 0) if checkpoint_dir \
         else 0
     lens: set[int] = set()
