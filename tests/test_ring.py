@@ -442,37 +442,39 @@ def test_flash_ring_gradients_noncausal_multitile(mesh8):
             np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
 
 
-def test_flash_backward_block_halves_to_divisor(mesh8):
-    """s_local=1536: the forward clamps its block to 1536 but the
-    backward's 1024 default does NOT divide it — the wrapper must halve
-    to 512 instead of raising (regression: the removed XLA-backward
-    fallback handled any length). Gradients through the halved blocks
-    must MATCH the XLA path, not merely be finite."""
-    import functools
+def test_flash_backward_block_halves_to_divisor():
+    """The backward wrapper must halve a non-dividing block down to a
+    divisor instead of raising (regression: the removed XLA-backward
+    fallback handled any length): s=384 with bq=bkv=256 halves to 128,
+    and the halved-block gradients equal the directly-sized ones."""
+    from tpu_distalg.ops.pallas_attention import (
+        flash_attention_backward_block,
+        flash_attention_block,
+    )
 
     rng = np.random.default_rng(21)
-    S, H, d = 12288, 1, 128  # s_local = 1536 on 8 shards
-    q, k, v = (rng.normal(size=(S, H, d)).astype(np.float32)
-               for _ in range(3))
-    qs, ks, vs = (parallelize(x, mesh8) for x in (q, k, v))
+    H, S, d = 1, 384, 128
+    qh, kh, vh = (jnp.asarray(rng.normal(size=(H, S, d)), jnp.float32)
+                  for _ in range(3))
+    o0 = jnp.zeros((H, S, d), jnp.float32)
+    m0 = jnp.full((H, S, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((H, S, 1), jnp.float32)
+    o, m, l = flash_attention_block(
+        qh, kh, vh, o0, m0, l0, 0, 0, scale=1.0 / np.sqrt(d),
+        causal=True, bq=128, bkv=128, interpret=True)
+    lse = m + jnp.log(l)
+    out = o / l
+    do = jnp.asarray(rng.normal(size=(H, S, d)), jnp.float32)
+    delta = jnp.sum(do * out, axis=-1, keepdims=True)
     grads = []
-    for kw in (dict(kv_chunk=512),
-               dict(use_flash=True, flash_interpret=True)):
-        f = data_parallel(
-            functools.partial(ring_attention, causal=True, **kw),
-            mesh8,
-            in_specs=(P("data", None, None),) * 3,
-            out_specs=P("data", None, None),
-        )
-
-        def loss(q_, k_, v_):
-            return jnp.sum(f(q_, k_, v_) ** 2)
-
-        grads.append(jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
-            qs.data, ks.data, vs.data))
-    for got, want in zip(grads[1], grads[0]):
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+    for bq, bkv in ((256, 256),   # 256 does not divide 384 -> halves
+                    (128, 128)):  # the directly-valid size
+        grads.append(flash_attention_backward_block(
+            qh, kh, vh, do, lse, delta, 0, 0, scale=1.0 / np.sqrt(d),
+            causal=True, bq=bq, bkv=bkv, interpret=True))
+    for a, b in zip(grads[0], grads[1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_ring_attention_flash_matches_dense(mesh8):

@@ -49,6 +49,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
+# Backward tile edge, measured-best at 32k tokens (71.9 TFLOP/s
+# backward-only vs 63.9 at 1024² and 51.0 at 512²); the four (B, B) f32
+# temporaries total ~64 MB, inside the 100 MB VMEM budget. The ring
+# VJPs cap their flash_block_* at this — the ONE place the value lives.
+BWD_BLOCK_MAX = 2048
+
 
 def _kernel(off_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
             o_ref, m_ref, l_ref, oacc, macc, lacc, *,
@@ -301,7 +307,8 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def flash_attention_backward_block(q, k, v, do, lse, delta,
                                    q_off, k_off, *,
                                    scale: float, causal: bool = False,
-                                   bq: int = 1024, bkv: int = 1024,
+                                   bq: int = BWD_BLOCK_MAX,
+                                   bkv: int = BWD_BLOCK_MAX,
                                    interpret: bool = False):
     """Gradients through one resident K/V block (FlashAttention-2 style).
 
@@ -314,10 +321,9 @@ def flash_attention_backward_block(q, k, v, do, lse, delta,
     into the dK/dV kernel's inner grid axis, so each KV head's
     cotangent group-sums in VMEM with no HBM-side segment reduce.
 
-    Default 1024-blocks keep the four (Bq, Bkv) f32 temporaries
-    (P, dP, dS and the score tile) near 16 MB total — the backward
-    holds more live tiles than the forward, so its default block is
-    half the forward's 2048.
+    The ``BWD_BLOCK_MAX`` default is the measured-best tile (see the
+    constant's comment) — bigger tiles amortize the per-tile mask/exp
+    overhead.
     """
     h, s_q, d = q.shape
     h_kv, s_kv = k.shape[0], k.shape[1]

@@ -225,8 +225,10 @@ def ring_attention(q, k, v, axis_name: str = DATA_AXIS, *,
             bq=flash_block_q, bkv=flash_block_kv,
         )
     if use_flash:
-        bwd_bq = min(flash_block_q, 1024)
-        bwd_bkv = min(flash_block_kv, 1024)
+        from tpu_distalg.ops.pallas_attention import BWD_BLOCK_MAX
+
+        bwd_bq = min(flash_block_q, BWD_BLOCK_MAX)
+        bwd_bkv = min(flash_block_kv, BWD_BLOCK_MAX)
         impl = functools.partial(
             _ring_attention_impl, axis_name=axis_name, scale=scale,
             kv_chunk=kv_chunk, causal=causal,
@@ -352,11 +354,13 @@ def _ring_attention_zigzag(q, k, v, *, axis_name, scale, use_flash,
         return out, (q, k, v, out, lse)
 
     def _bwd(res, g):
+        from tpu_distalg.ops.pallas_attention import BWD_BLOCK_MAX
+
         qq, kk, vv, out, lse = res
         return _zigzag_flash_backward(
             qq, kk, vv, out, lse, g, axis_name=axis_name, scale=scale,
             flash_interpret=flash_interpret,
-            bq=min(bq, 1024), bkv=min(bkv, 1024))
+            bq=min(bq, BWD_BLOCK_MAX), bkv=min(bkv, BWD_BLOCK_MAX))
 
     flash_fn.defvjp(_fwd, _bwd)
     return flash_fn(q, k, v)
