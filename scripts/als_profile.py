@@ -1,0 +1,106 @@
+"""ALS sweep attribution: scan-wrapped component micro-benchmarks."""
+import jax, jax.numpy as jnp
+from jax import lax
+from tpu_distalg.ops import linalg
+from tpu_distalg.utils import profiling, prng
+
+m, n, k, sweeps = 4096, 16384, 64, 50  # bench.py's ALS geometry
+key = prng.root_key(0)
+U0 = jax.random.normal(jax.random.fold_in(key, 0), (m, k)) * 0.3
+V0 = jax.random.normal(jax.random.fold_in(key, 1), (n, k)) * 0.3
+R = U0 @ V0.T
+Ui = jax.random.normal(jax.random.fold_in(key, 2), (m, k)) * 0.1
+Vi = jax.random.normal(jax.random.fold_in(key, 3), (n, k)) * 0.1
+HI = lax.Precision.HIGHEST
+
+def scan_bench(name, body):
+    @jax.jit
+    def run(R, U, V):
+        def step(carry, _):
+            return body(R, *carry), None
+        (U, V), _ = lax.scan(step, (U, V), None, length=sweeps)
+        return U, V
+    best, _ = profiling.steps_per_sec(lambda: run(R, Ui, Vi), steps=sweeps,
+                                      with_stats=True, repeats=3, chain=8)
+    print(f"{name}: {best:.0f} /s  ({1e3/best:.3f} ms each)")
+    return best
+
+# full sweep (what bench measures, incl rmse)
+def full(R, U, V):
+    G_v = linalg.gram(V, 0.0, n)
+    U = linalg.solve_factor_block(G_v, V, R)
+    G_u = linalg.gram(U, 0.0, m)
+    V = linalg.solve_factor_block(G_u, U, R.T)
+    diff = R - jnp.matmul(U, V.T, precision=HI)
+    err = jnp.sqrt(jnp.sum(diff * diff) / (m * n))
+    return U + 0 * err, V
+scan_bench("full sweep      ", full)
+
+# solves only (no rmse)
+def solves(R, U, V):
+    G_v = linalg.gram(V, 0.0, n)
+    U = linalg.solve_factor_block(G_v, V, R)
+    G_u = linalg.gram(U, 0.0, m)
+    V = linalg.solve_factor_block(G_u, U, R.T)
+    return U, V
+scan_bench("solves only     ", solves)
+
+# rmse only
+def rmse_only(R, U, V):
+    diff = R - jnp.matmul(U, V.T, precision=HI)
+    err = jnp.sqrt(jnp.sum(diff * diff) / (m * n))
+    return U + 0 * err, V
+scan_bench("rmse only       ", rmse_only)
+
+# solves with DEFAULT-precision rhs (precision attribution)
+def solves_default(R, U, V):
+    FtF = jnp.matmul(V.T, V, precision=HI)
+    G_v = FtF + 0.0
+    rhs = jnp.matmul(V.T, R.T)
+    cho = jax.scipy.linalg.cho_factor(G_v)
+    U = jax.scipy.linalg.cho_solve(cho, rhs).T
+    FtF2 = jnp.matmul(U.T, U, precision=HI)
+    rhs2 = jnp.matmul(U.T, R)
+    cho2 = jax.scipy.linalg.cho_factor(FtF2)
+    V = jax.scipy.linalg.cho_solve(cho2, rhs2).T
+    return U, V
+scan_bench("solves DEFAULT  ", solves_default)
+
+# rmse via 3-pass (bf16x3) instead of 6-pass
+def rmse_3pass(R, U, V):
+    diff = R - jnp.matmul(U, V.T, precision=lax.Precision.HIGH)
+    err = jnp.sqrt(jnp.sum(diff * diff) / (m * n))
+    return U + 0 * err, V
+try:
+    scan_bench("rmse HIGH(3pass)", rmse_3pass)
+except Exception as e:
+    print("rmse HIGH failed:", type(e).__name__)
+
+# blocked rmse: avoid materializing the full (m, n) diff
+def rmse_blocked(R, U, V):
+    B = 2048
+    def blk(c, j):
+        Vb = lax.dynamic_slice(V, (j, 0), (B, k))
+        Rb = lax.dynamic_slice(R, (0, j), (m, B))
+        d = Rb - jnp.matmul(U, Vb.T, precision=HI)
+        return c + jnp.sum(d * d), None
+    s, _ = lax.scan(blk, jnp.float32(0), jnp.arange(0, n, B))
+    err = jnp.sqrt(s / (m * n))
+    return U + 0 * err, V
+scan_bench("rmse blocked    ", rmse_blocked)
+
+def full_blocked(R, U, V):
+    G_v = linalg.gram(V, 0.0, n)
+    U = linalg.solve_factor_block(G_v, V, R)
+    G_u = linalg.gram(U, 0.0, m)
+    V = linalg.solve_factor_block(G_u, U, R.T)
+    B = 2048
+    def blk(c, j):
+        Vb = lax.dynamic_slice(V, (j, 0), (B, k))
+        Rb = lax.dynamic_slice(R, (0, j), (m, B))
+        d = Rb - jnp.matmul(U, Vb.T, precision=HI)
+        return c + jnp.sum(d * d), None
+    s, _ = lax.scan(blk, jnp.float32(0), jnp.arange(0, n, B))
+    err = jnp.sqrt(s / (m * n))
+    return U + 0 * err, V
+scan_bench("full blocked    ", full_blocked)

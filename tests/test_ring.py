@@ -466,15 +466,24 @@ def test_flash_backward_block_halves_to_divisor():
     out = o / l
     do = jnp.asarray(rng.normal(size=(H, S, d)), jnp.float32)
     delta = jnp.sum(do * out, axis=-1, keepdims=True)
-    grads = []
-    for bq, bkv in ((256, 256),   # 256 does not divide 384 -> halves
-                    (128, 128)):  # the directly-valid size
-        grads.append(flash_attention_backward_block(
-            qh, kh, vh, do, lse, delta, 0, 0, scale=1.0 / np.sqrt(d),
-            causal=True, bq=bq, bkv=bkv, interpret=True))
-    for a, b in zip(grads[0], grads[1]):
+    # independent oracle: autodiff through dense causal attention (NOT
+    # another kernel config, which would compare the halved kernel to
+    # itself)
+    def dense(q_, k_, v_):
+        sc = jnp.einsum("hqd,hkd->hqk", q_, k_) / np.sqrt(d)
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        p = jax.nn.softmax(jnp.where(mask[None], sc, -jnp.inf), axis=-1)
+        return jnp.einsum("hqk,hkd->hqd", p, v_)
+
+    _, vjp = jax.vjp(dense, qh, kh, vh)
+    want = vjp(do)
+    got = flash_attention_backward_block(
+        qh, kh, vh, do, lse, delta, 0, 0, scale=1.0 / np.sqrt(d),
+        causal=True, bq=256, bkv=256,  # 256 ∤ 384 -> halves to 128
+        interpret=True)
+    for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_ring_attention_flash_matches_dense(mesh8):

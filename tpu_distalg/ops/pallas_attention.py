@@ -328,9 +328,10 @@ def flash_attention_backward_block(q, k, v, do, lse, delta,
     h, s_q, d = q.shape
     h_kv, s_kv = k.shape[0], k.shape[1]
     # halve down to a divisor: the forward accepts any length whose
-    # clamped block divides it, so the backward must too (e.g.
-    # s_local=1536 clamps min(1024,1536)=1024 which does NOT divide —
-    # 512 does)
+    # clamped block divides it, so the backward must too (e.g. an
+    # explicit bq=256 with s_q=384 does NOT divide — 128 does; the
+    # same arises whenever a caller-supplied block exceeds a divisor
+    # of the sequence)
     bq = min(bq, s_q)
     while bq > 8 and s_q % bq:
         bq //= 2

@@ -53,10 +53,30 @@ round doesn't re-walk it):
     here is therefore 2D lane-major blocks. An (E, 1) column layout is
     equally fatal: TPU pads the lane dim to 128 (128× HBM traffic).
 
-A fully-fused tiled SpMV (edges sorted by (dst-block, src) so BOTH
-sides ride vreg windows, gather via per-vreg lane-gather + select)
-pencils out to ~3-4 ns/edge but multiplies kernel complexity; it is
-the known next step if the sweep ever needs to go faster.
+The known next step if the sweep ever needs to go faster — a
+fully-fused tiled SpMV — was costed in round 4 but not built:
+
+  * the missing primitive EXISTS: Mosaic also lowers a LANE-direction
+    ``dynamic_gather`` (``take_along_axis(x, idx, axis=1)`` with
+    same-shape operands, verified working including multi-vreg row
+    batches), so a full (8, 128)-vreg gather is 8 lane-gathers + 8
+    selects — no lane constraint on edge placement;
+  * sort edges by (src-block of V/n vertices, dst); per 1024-edge
+    chunk the gather windows over 1024/n vregs of the rank table
+    (selector ≈ 24·W ops) and the scatter windows over ≈n/8+1 vregs
+    (dst-sorted within group). With a bf16 hi+lo split for the
+    scatter matmul (2-pass, ~1.5e-5 relative — near-f32) the optimum
+    near n=32 pencils to ~1.4 VPU-cycles/edge + builds ≈ 3 ns/edge,
+    ~2× this hybrid;
+  * the costs NOT in the pencil: the gather chunk must be (8, 128)
+    (lane-gather needs a 128-lane axis) while the scatter matmul
+    wants the edge dim as one 1024-lane axis — bridging them means 8
+    per-sublane (rows_w, 128)@(128, 128) matmuls and sublane
+    extraction glue; plus per-group chunk padding and a two-key host
+    sort. Every windowed-kernel estimate this round landed ~2× under
+    the measured result once loop overhead was counted, which prices
+    the fused kernel at ~5-7 ns/edge end-to-end — a 1.3-1.8× for
+    ~300 lines of delicate kernel; deferred, not disproven.
 """
 
 from __future__ import annotations
