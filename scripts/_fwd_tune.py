@@ -1,0 +1,27 @@
+"""Forward flash block tuning at 32k."""
+import functools
+import jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from tpu_distalg.parallel import DATA_AXIS, data_parallel, get_mesh
+from tpu_distalg.parallel.ring import ring_attention
+from tpu_distalg.utils import profiling, prng
+
+mesh = get_mesh()
+S, H, d = 32768, 8, 128
+key = prng.root_key(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (S, H, d), jnp.bfloat16)
+           for i in range(3))
+flops = S * S / 2 * d * H * 2 * 2
+for bq, bkv in [(2048, 2048), (4096, 2048), (2048, 4096), (4096, 4096),
+                (8192, 2048), (1024, 4096), (4096, 1024), (8192, 1024)]:
+    try:
+        f = jax.jit(data_parallel(
+            functools.partial(ring_attention, causal=True, use_flash=True,
+                              flash_block_q=bq, flash_block_kv=bkv),
+            mesh, in_specs=(P(DATA_AXIS, None, None),) * 3,
+            out_specs=P(DATA_AXIS, None, None)))
+        best, _ = profiling.steps_per_sec(lambda: f(q, k, v), steps=1,
+                                          with_stats=True, repeats=3, chain=4)
+        print(f"bq={bq} bkv={bkv}: {flops*best/1e12:.1f} TFLOP/s fwd")
+    except Exception as e:
+        print(f"bq={bq} bkv={bkv}: FAILED {type(e).__name__}")

@@ -1,0 +1,23 @@
+import functools
+import jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from tpu_distalg.parallel import DATA_AXIS, data_parallel, get_mesh
+from tpu_distalg.parallel.ring import ring_attention
+from tpu_distalg.utils import profiling, prng
+
+mesh = get_mesh()
+S, H, d = 32768, 8, 128
+key = prng.root_key(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (S, H, d), jnp.bfloat16)
+           for i in range(3))
+for causal in (True, False):
+    f = jax.jit(data_parallel(
+        functools.partial(ring_attention, causal=causal, use_flash=True),
+        mesh, in_specs=(P(DATA_AXIS, None, None),) * 3,
+        out_specs=P(DATA_AXIS, None, None)))
+    best, _ = profiling.steps_per_sec(lambda: f(q, k, v), steps=1,
+                                      with_stats=True, repeats=3, chain=4)
+    frac = 0.5 if causal else 1.0
+    flops = S * S * frac * d * H * 2 * 2
+    print(f"causal={causal}: {flops*best/1e12:.1f} TFLOP/s "
+          f"({1e3/best:.1f} ms/call)")

