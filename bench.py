@@ -504,8 +504,8 @@ def _bench_kmeans_scale(mesh, n_chips):
 
 def _bench_ssgd_virtual(mesh, n_chips):
     """The >HBM story (TPU only): SSGD over a 1B-row LOGICAL dataset on
-    whatever chips are present — ~5.2x one v5e's HBM if materialised
-    f32 (~2x if bf16-packed at the flagship's 64 B/row). No row is ever
+    whatever chips are present — ~7.8x one v5e's HBM if materialised
+    f32 at d=31 (the emitted ``hbm_ratio_f32`` field computes it). No row is ever
     stored: each step regenerates exactly the sampled blocks from the
     counter-based row generator (models/ssgd_virtual.py), replacing the
     Spark spill/lineage capability the reference gets silently from

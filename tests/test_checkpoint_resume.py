@@ -300,7 +300,49 @@ def test_watchdog_recovers_bitwise_from_guard_trip(mesh8, data, tmp_path,
                                   np.asarray(res.accs))
 
 
-def test_fused_train_segment_guard_catches_all_segment_lengths(data):
+def test_corrupt_checkpoint_quarantined_by_watchdog(mesh8, data, tmp_path):
+    """Advisor r4: a checkpoint half-written by the crash being survived
+    used to kill the watchdog (restore's ValueError was treated as a
+    config error). It must instead quarantine the corrupt file and
+    resume from the previous step — bitwise-equal to a straight run."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    X_train, y_train, X_test, y_test = data
+    d = str(tmp_path / "ck")
+    ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+               ssgd.SSGDConfig(n_iterations=60),
+               checkpoint_dir=d, checkpoint_every=30)  # steps 30, 60
+    newest = os.path.join(d, "step_60.msgpack")
+    with open(newest, "wb") as f:
+        f.write(b"\xff\xfe not msgpack")
+
+    # without retries the corrupt file is a hard error (carries path)
+    with pytest.raises(ckpt.CorruptCheckpointError):
+        ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                   ssgd.SSGDConfig(n_iterations=120),
+                   checkpoint_dir=d, checkpoint_every=30)
+
+    msgs = []
+    resumed = ckpt.run_with_restarts(
+        lambda: ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                           ssgd.SSGDConfig(n_iterations=120),
+                           checkpoint_dir=d, checkpoint_every=30),
+        max_restarts=1, logger=msgs.append)
+    assert any("quarantine" in m for m in msgs)
+    # quarantine retries must NOT consume the restart budget (r4 review:
+    # a crash that also corrupts the newest checkpoint would otherwise
+    # exhaust max_restarts=1 before reaching the corrupt file)
+    assert any("0/1 used" in m for m in msgs)
+    assert os.path.exists(newest + ".corrupt")
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                          ssgd.SSGDConfig(n_iterations=120))
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(resumed.w))
+
+
+def test_fused_train_segment_guard_catches_all_segment_lengths(data, tmp_path):
     """Advisor r3: eval_test=True with checkpoint_every not a multiple
     of mega_steps used to raise the builder's 'segment boundaries'
     error MID-RUN; the guard must fire up front — including for the
@@ -317,12 +359,12 @@ def test_fused_train_segment_guard_catches_all_segment_lengths(data):
     # != eval_every=125 -> up-front error
     with pytest.raises(ValueError, match="launch boundary"):
         ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg,
-                   checkpoint_dir="/tmp/unused_guard_a",
+                   checkpoint_dir=str(tmp_path / "guard_a"),
                    checkpoint_every=100)
     # full length is valid (500 % 125 == 0) but the segment is not:
     # checkpoint_every=300 -> segment mega=125 doesn't divide 300 —
     # must fail up front, not at the second segment build mid-run
     with pytest.raises(ValueError, match="not divisible by mega_steps"):
         ssgd.train(X_train, y_train, X_test, y_test, mesh1, cfg,
-                   checkpoint_dir="/tmp/unused_guard_b",
+                   checkpoint_dir=str(tmp_path / "guard_b"),
                    checkpoint_every=300)

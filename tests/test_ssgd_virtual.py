@@ -61,6 +61,16 @@ def test_virtual_odd_row_count_masks_padding(mesh8):
     assert np.isfinite(np.asarray(res.w)).all()
 
 
+def test_virtual_coarse_fraction_warns(mesh8):
+    """Advisor r4: a coarse block grid silently quantized the minibatch
+    fraction (frac=0.01 with 50 blocks/shard samples 2%) — _geometry
+    must warn the way fused_gather_geometry does."""
+    data = ssgd_virtual.VirtualData(n_rows=8 * 256 * 50, n_features=8)
+    with pytest.warns(UserWarning, match="quantizes the minibatch"):
+        ssgd_virtual.make_train_fn(
+            mesh8, _cfg(mini_batch_fraction=0.01), data)
+
+
 def test_virtual_rejects_wrong_sampler(mesh8):
     data = ssgd_virtual.VirtualData(n_rows=1024)
     with pytest.raises(ValueError, match="sampler"):

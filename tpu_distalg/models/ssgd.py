@@ -266,18 +266,26 @@ def fused_gather_geometry(config: SSGDConfig, meta: dict, n_shards: int):
             f"with block_rows a multiple of gather_block_rows × n_shards"
         )
     n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+    warn_quantized_fraction(
+        "fused_gather", n_blocks, n_sampled, config.mini_batch_fraction,
+        "lower gather_block_rows or fused_pack for a finer grid")
+    return n_blocks, n_sampled
+
+
+def warn_quantized_fraction(prefix: str, n_blocks: int, n_sampled: int,
+                            frac: float, remedy: str) -> None:
+    """Warn when the block grid quantizes the configured minibatch
+    fraction by more than 25% — shared by every block-cluster sampler
+    so the tolerance and message cannot drift between them."""
     eff = n_sampled / n_blocks
-    if abs(eff - config.mini_batch_fraction) > \
-            0.25 * config.mini_batch_fraction:
+    if abs(eff - frac) > 0.25 * frac:
         import warnings
 
         warnings.warn(
-            f"fused_gather: {n_blocks} blocks/shard quantizes the "
-            f"minibatch fraction to {eff:.3f} (configured "
-            f"{config.mini_batch_fraction}); lower gather_block_rows "
-            f"or fused_pack for a finer grid", stacklevel=2,
+            f"{prefix}: {n_blocks} blocks/shard quantizes the minibatch "
+            f"fraction to {eff:.3f} (configured {frac}); {remedy}",
+            stacklevel=3,
         )
-    return n_blocks, n_sampled
 
 
 def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_distalg.models.ssgd import SSGDConfig, TrainResult, _build_scan
+from tpu_distalg.models.ssgd import SSGDConfig, TrainResult, \
+    _build_scan, warn_quantized_fraction
 from tpu_distalg.ops import logistic, sampling
 from tpu_distalg.parallel import DATA_AXIS, data_parallel, \
     tree_allreduce_sum
@@ -66,6 +67,9 @@ def _geometry(config: SSGDConfig, data: VirtualData, n_shards: int):
     rows_per_shard = -(-data.n_rows // (n_shards * br)) * br
     n_blocks = rows_per_shard // br
     n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+    warn_quantized_fraction(
+        "virtual", n_blocks, n_sampled, config.mini_batch_fraction,
+        "lower gather_block_rows for a finer grid")
     return rows_per_shard, n_blocks, n_sampled
 
 

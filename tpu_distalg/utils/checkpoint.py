@@ -21,6 +21,19 @@ import numpy as np
 _STEP_RE = re.compile(r"^step_(\d+)\.msgpack$")
 
 
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file exists but will not deserialize — e.g. it was
+    half-written by the same crash the watchdog exists to survive (the
+    atomic rename in :func:`save` prevents this for clean process
+    deaths, but not for disk faults). Carries the offending ``path`` so
+    :func:`run_with_restarts` can quarantine it and resume from the
+    previous step instead of dying on a retryable condition."""
+
+    def __init__(self, path: str, msg: str):
+        super().__init__(msg)
+        self.path = path
+
+
 def save(ckpt_dir: str, tree: Any, step: int) -> str:
     """Write ``tree`` at ``ckpt_dir/step_<step>.msgpack`` (atomic rename)."""
     from flax import serialization
@@ -61,7 +74,8 @@ def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
     try:
         tree = serialization.msgpack_restore(payload)
     except Exception as e:
-        raise ValueError(
+        raise CorruptCheckpointError(
+            path,
             f"corrupt checkpoint {path} ({type(e).__name__}: {e}); delete "
             f"it to resume from an earlier step"
         ) from e
@@ -188,7 +202,11 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
     errors (``ValueError``/``TypeError``/``FileNotFoundError`` — e.g.
     an incompatible checkpoint directory) fail identically every time,
     so they are never retried; ``KeyboardInterrupt``/``SystemExit``
-    are never caught.
+    are never caught. The one retryable ``ValueError`` is
+    :class:`CorruptCheckpointError`: the offending file is quarantined
+    (renamed ``*.corrupt``) and the retry resumes from the previous
+    step — a checkpoint corrupted by the very crash being survived must
+    not kill the watchdog.
     """
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
@@ -196,6 +214,30 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
     while True:
         try:
             return run_once()
+        except CorruptCheckpointError as e:
+            # quarantine retries do NOT consume the restart budget: a
+            # crash that also corrupts the newest checkpoint would
+            # otherwise spend attempt 1 on the crash and die on the
+            # corrupt file at max_restarts=1 — the exact scenario this
+            # path exists for. The loop still terminates: each pass
+            # renames one distinct on-disk file, and restore() can only
+            # trip on files that exist. max_restarts=0 means "no
+            # recovery of any kind" and still raises.
+            if max_restarts == 0:
+                raise
+            try:
+                os.replace(e.path, e.path + ".corrupt")
+            except OSError as os_err:
+                (logger or print)(
+                    f"could not quarantine corrupt checkpoint {e.path} "
+                    f"({os_err}); manual cleanup required"
+                )
+                raise e from os_err
+            (logger or print)(
+                f"[quarantine] corrupt checkpoint {e.path} -> .corrupt; "
+                f"resuming from the previous step (restart budget "
+                f"untouched: {attempt}/{max_restarts} used)"
+            )
         except (ValueError, TypeError, FileNotFoundError):
             raise  # deterministic config error — retrying cannot help
         except Exception as e:  # noqa: BLE001 — anything restartable
