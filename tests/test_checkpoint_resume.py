@@ -300,6 +300,189 @@ def test_watchdog_recovers_bitwise_from_guard_trip(mesh8, data, tmp_path,
                                   np.asarray(res.accs))
 
 
+# ---- non-optimizer workloads (r4 verdict ask #5): Spark gives the
+# reference task retry on every script, so every workload here must
+# checkpoint/resume, not just the SGD family ----
+
+
+def test_kmeans_segmented_equals_straight(mesh4, tmp_path):
+    from tpu_distalg.models import kmeans
+    from tpu_distalg.utils import datasets
+
+    pts = datasets.gaussian_mixture(4000, k=3, seed=1)
+    cfg = kmeans.KMeansConfig(k=3, n_iterations=10)
+    straight = kmeans.fit(pts, mesh4, cfg)
+    seg = kmeans.fit(pts, mesh4, cfg,
+                     checkpoint_dir=str(tmp_path / "km"),
+                     checkpoint_every=4)
+    np.testing.assert_array_equal(np.asarray(straight.centers),
+                                  np.asarray(seg.centers))
+    assert seg.n_iterations_run == 10
+
+
+def test_kmeans_resume_from_checkpoint(mesh4, tmp_path):
+    from tpu_distalg.models import kmeans
+    from tpu_distalg.utils import datasets
+
+    pts = datasets.gaussian_mixture(4000, k=3, seed=1)
+    d = str(tmp_path / "km")
+    kmeans.fit(pts, mesh4, kmeans.KMeansConfig(k=3, n_iterations=4),
+               checkpoint_dir=d, checkpoint_every=4)
+    resumed = kmeans.fit(pts, mesh4,
+                         kmeans.KMeansConfig(k=3, n_iterations=10),
+                         checkpoint_dir=d, checkpoint_every=4)
+    straight = kmeans.fit(pts, mesh4,
+                          kmeans.KMeansConfig(k=3, n_iterations=10))
+    np.testing.assert_array_equal(np.asarray(straight.centers),
+                                  np.asarray(resumed.centers))
+
+
+def test_kmeans_converge_mode_segmented(mesh4, tmp_path):
+    """Converge mode carries (shift, n_run) across segments: same
+    centers and same iteration count as the straight while_loop, and
+    convergence stops the segment loop early (stop_when)."""
+    from tpu_distalg.models import kmeans
+    from tpu_distalg.utils import datasets
+
+    pts = datasets.gaussian_mixture(4000, k=3, seed=1)
+    cfg = kmeans.KMeansConfig(k=3, converge_dist=1e-4,
+                              max_iterations=200)
+    straight = kmeans.fit(pts, mesh4, cfg)
+    seg = kmeans.fit(pts, mesh4, cfg,
+                     checkpoint_dir=str(tmp_path / "km"),
+                     checkpoint_every=5)
+    assert straight.n_iterations_run < 200  # actually converged
+    assert seg.n_iterations_run == straight.n_iterations_run
+    np.testing.assert_array_equal(np.asarray(straight.centers),
+                                  np.asarray(seg.centers))
+    # far fewer checkpoints than max_iterations/5 segments were written
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    assert ckpt.latest_step(str(tmp_path / "km")) <= \
+        straight.n_iterations_run + 5
+
+
+def test_pagerank_segmented_equals_straight(mesh4, tmp_path):
+    from tpu_distalg.models import pagerank
+    from tpu_distalg.utils import datasets
+
+    edges = datasets.erdos_renyi_edges(400, 4.0, seed=2)
+    for mode in ("reference", "standard"):
+        cfg = pagerank.PageRankConfig(n_iterations=10, mode=mode)
+        straight = pagerank.run(edges, mesh4, cfg)
+        seg = pagerank.run(edges, mesh4, cfg,
+                           checkpoint_dir=str(tmp_path / f"pr_{mode}"),
+                           checkpoint_every=4)
+        np.testing.assert_array_equal(np.asarray(straight.ranks),
+                                      np.asarray(seg.ranks))
+        np.testing.assert_array_equal(np.asarray(straight.has_rank),
+                                      np.asarray(seg.has_rank))
+
+
+def test_pagerank_resume_from_checkpoint(mesh4, tmp_path):
+    from tpu_distalg.models import pagerank
+    from tpu_distalg.utils import datasets
+
+    edges = datasets.erdos_renyi_edges(400, 4.0, seed=2)
+    d = str(tmp_path / "pr")
+    pagerank.run(edges, mesh4,
+                 pagerank.PageRankConfig(n_iterations=4,
+                                         mode="standard"),
+                 checkpoint_dir=d, checkpoint_every=4)
+    resumed = pagerank.run(
+        edges, mesh4,
+        pagerank.PageRankConfig(n_iterations=10, mode="standard"),
+        checkpoint_dir=d, checkpoint_every=4)
+    straight = pagerank.run(
+        edges, mesh4,
+        pagerank.PageRankConfig(n_iterations=10, mode="standard"))
+    np.testing.assert_array_equal(np.asarray(straight.ranks),
+                                  np.asarray(resumed.ranks))
+
+
+def test_closure_dense_segmented_and_resume(mesh4, tmp_path):
+    from tpu_distalg.models import transitive_closure as tc
+    from tpu_distalg.utils import datasets
+
+    edges = datasets.chain_forest_edges(48)
+    straight = tc.run(edges, mesh4)
+    d = str(tmp_path / "cl")
+    seg = tc.run(edges, mesh4, checkpoint_dir=d, checkpoint_every=2)
+    assert seg.n_paths == straight.n_paths
+    assert seg.n_rounds == straight.n_rounds
+    np.testing.assert_array_equal(np.asarray(straight.paths),
+                                  np.asarray(seg.paths))
+
+    # resume: cap the fixpoint at 3 rounds (simulated interruption),
+    # then rerun uncapped from the same directory
+    d2 = str(tmp_path / "cl2")
+    tc.run(edges, mesh4, tc.ClosureConfig(max_iterations=3),
+           checkpoint_dir=d2, checkpoint_every=2)
+    resumed = tc.run(edges, mesh4, checkpoint_dir=d2,
+                     checkpoint_every=2)
+    assert resumed.n_paths == straight.n_paths
+    np.testing.assert_array_equal(np.asarray(straight.paths),
+                                  np.asarray(resumed.paths))
+
+
+def test_closure_sparse_segmented_and_resume(mesh4, tmp_path):
+    from tpu_distalg.models import transitive_closure as tc
+    from tpu_distalg.utils import datasets
+
+    edges = datasets.chain_forest_edges(48)
+    straight = tc.run_sparse(edges, mesh4)
+    seg = tc.run_sparse(edges, mesh4,
+                        checkpoint_dir=str(tmp_path / "cls"),
+                        checkpoint_every=2)
+    assert seg.n_paths == straight.n_paths
+    assert seg.n_rounds == straight.n_rounds
+    np.testing.assert_array_equal(straight.paths, seg.paths)
+
+    d2 = str(tmp_path / "cls2")
+    tc.run_sparse(edges, mesh4,
+                  tc.SparseClosureConfig(max_iterations=3),
+                  checkpoint_dir=d2, checkpoint_every=2)
+    resumed = tc.run_sparse(edges, mesh4, checkpoint_dir=d2,
+                            checkpoint_every=2)
+    assert resumed.n_paths == straight.n_paths
+    np.testing.assert_array_equal(straight.paths, resumed.paths)
+
+
+def test_workload_checkpoint_dirs_not_interchangeable(mesh4, tmp_path):
+    """A k-means directory must not resume a PageRank run: the tag check
+    fails loudly (the same contract the optimizer family has)."""
+    from tpu_distalg.models import kmeans, pagerank
+    from tpu_distalg.utils import datasets
+
+    pts = datasets.gaussian_mixture(4000, k=3, seed=1)
+    d = str(tmp_path / "mix")
+    kmeans.fit(pts, mesh4, kmeans.KMeansConfig(k=3, n_iterations=4),
+               checkpoint_dir=d, checkpoint_every=4)
+    edges = datasets.erdos_renyi_edges(400, 4.0, seed=2)
+    with pytest.raises(ValueError, match="incompatible"):
+        pagerank.run(edges, mesh4,
+                     pagerank.PageRankConfig(n_iterations=10),
+                     checkpoint_dir=d, checkpoint_every=4)
+
+    # cross-MODE resumes must also fail: the state signatures alias
+    # ((V,) f32 pair for pagerank; fixed-mode kmeans saves shift=0.0,
+    # which converge mode would read as "already converged")
+    d2 = str(tmp_path / "pr_ref")
+    pagerank.run(edges, mesh4,
+                 pagerank.PageRankConfig(n_iterations=4,
+                                         mode="reference"),
+                 checkpoint_dir=d2, checkpoint_every=4)
+    with pytest.raises(ValueError, match="incompatible"):
+        pagerank.run(edges, mesh4,
+                     pagerank.PageRankConfig(n_iterations=10,
+                                             mode="standard"),
+                     checkpoint_dir=d2, checkpoint_every=4)
+    with pytest.raises(ValueError, match="incompatible"):
+        kmeans.fit(pts, mesh4,
+                   kmeans.KMeansConfig(k=3, converge_dist=1e-4),
+                   checkpoint_dir=d, checkpoint_every=4)
+
+
 def test_corrupt_checkpoint_quarantined_by_watchdog(mesh8, data, tmp_path):
     """Advisor r4: a checkpoint half-written by the crash being survived
     used to kill the watchdog (restore's ValueError was treated as a

@@ -54,9 +54,17 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     p.add_argument("--plot", type=str, default=None,
                    help="save an accuracy plot PNG here")
     p.add_argument("--quiet", action="store_true")
+    _add_ckpt(p, 500)
+
+
+def _add_ckpt(p, every_default):
+    """Checkpoint/watchdog flags — on EVERY subcommand, optimizer or
+    not: the task-retry capability Spark gives every reference script
+    (r4 verdict ask #5). State is tiny in each case (weights / centers
+    / rank vector / path buffer / factor matrices)."""
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="segmented checkpoint/resume directory")
-    p.add_argument("--checkpoint-every", type=int, default=500)
+    p.add_argument("--checkpoint-every", type=int, default=every_default)
     p.add_argument("--max-restarts", type=int, default=0,
                    help="auto-restart the run up to N times on crash or "
                         "NaN-guard trip; with --checkpoint-dir each "
@@ -140,6 +148,7 @@ def main(argv=None):
                    help="point dimension for --scale-points")
     p.add_argument("--plot", type=str, default=None,
                    help="save a cluster scatter PNG (2-D data)")
+    _add_ckpt(p, 100)
 
     p = sub.add_parser("pagerank")
     p.add_argument("--n-slices", type=int, default=0)
@@ -161,6 +170,7 @@ def main(argv=None):
                         "by the native C++ ingest runtime")
     p.add_argument("--edge-capacity", type=int, default=1 << 24,
                    help="max edges the file parser may return")
+    _add_ckpt(p, 5)
 
     p = sub.add_parser("closure", help="transitive closure")
     p.add_argument("--n-slices", type=int, default=0)
@@ -175,6 +185,7 @@ def main(argv=None):
                         "modes")
     p.add_argument("--capacity", type=int, default=0,
                    help="sparse path-buffer capacity; 0 = 8x edges")
+    _add_ckpt(p, 8)
 
     p = sub.add_parser("als", help="ALS matrix decomposition")
     p.add_argument("--n-slices", type=int, default=0)
@@ -183,10 +194,14 @@ def main(argv=None):
     p.add_argument("--k", type=int, default=10)
     p.add_argument("--lam", type=float, default=0.01)
     p.add_argument("--n-iterations", type=int, default=5)
+    _add_ckpt(p, 5)
 
     p = sub.add_parser("mc", help="Monte-Carlo pi")
     p.add_argument("--n-slices", type=int, default=0)
     p.add_argument("--n", type=int, default=400_000)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="retry the (stateless, deterministic) estimate "
+                        "up to N times on a device crash")
 
     args = parser.parse_args(argv)
 
@@ -336,25 +351,39 @@ def _dispatch(args, jax):
 
     elif args.cmd == "kmeans":
         from tpu_distalg.models import kmeans as m
+        from tpu_distalg.utils import checkpoint as ckpt
         from tpu_distalg.utils import datasets
 
+        mesh = _mesh(args)
         if args.scale_points:
             make_rows, _ = datasets.gaussian_mixture_rows(
                 k=args.k, dim=args.dim, seed=0)
-            res = m.fit_scaled(
-                _mesh(args), args.scale_points, make_rows,
-                m.KMeansConfig(k=args.k,
-                               n_iterations=args.n_iterations,
-                               converge_dist=args.converge_dist,
-                               init="farthest"))
+
+            def run_once():
+                return m.fit_scaled(
+                    mesh, args.scale_points, make_rows,
+                    m.KMeansConfig(k=args.k,
+                                   n_iterations=args.n_iterations,
+                                   converge_dist=args.converge_dist,
+                                   init="farthest"),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
+
             pts = None  # points never leave the devices (O(k) host RAM)
         else:
             pts = (datasets.toy_kmeans_matrix() if args.n_points == 0
                    else datasets.gaussian_mixture(args.n_points,
                                                   k=args.k))
-            res = m.fit(pts, _mesh(args), m.KMeansConfig(
-                k=args.k, n_iterations=args.n_iterations,
-                converge_dist=args.converge_dist))
+
+            def run_once():
+                return m.fit(pts, mesh, m.KMeansConfig(
+                    k=args.k, n_iterations=args.n_iterations,
+                    converge_dist=args.converge_dist),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
+
+        res = ckpt.run_with_restarts(
+            run_once, max_restarts=args.max_restarts)
         print(f"Final centers: {res.centers.tolist()}")
         print(f"iterations run: {res.n_iterations_run}")
         if args.plot and pts is None:
@@ -384,10 +413,17 @@ def _dispatch(args, jax):
             edges = datasets.toy_graph_edges()
         else:
             edges = datasets.erdos_renyi_edges(args.n_vertices)
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        mesh = _mesh(args)
         t0 = time.perf_counter()
-        res = m.run(edges, _mesh(args), m.PageRankConfig(
-            n_iterations=args.n_iterations, q=args.q, mode=args.mode,
-            scatter=args.scatter))
+        res = ckpt.run_with_restarts(
+            lambda: m.run(edges, mesh, m.PageRankConfig(
+                n_iterations=args.n_iterations, q=args.q,
+                mode=args.mode, scatter=args.scatter),
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every),
+            max_restarts=args.max_restarts)
         jax.block_until_ready(res.ranks)
         dt = time.perf_counter() - t0
         import numpy as np
@@ -413,28 +449,49 @@ def _dispatch(args, jax):
             edges = datasets.chain_forest_edges(args.n_vertices)
         else:
             edges = datasets.erdos_renyi_edges(args.n_vertices, 2.0)
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        mesh = _mesh(args)
         if args.sparse:
-            res = m.run_sparse(edges, _mesh(args), m.SparseClosureConfig(
-                capacity=args.capacity or None))
+            def run_once():
+                return m.run_sparse(
+                    edges, mesh,
+                    m.SparseClosureConfig(capacity=args.capacity or None),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
         else:
-            res = m.run(edges, _mesh(args))
+            def run_once():
+                return m.run(edges, mesh,
+                             checkpoint_dir=args.checkpoint_dir,
+                             checkpoint_every=args.checkpoint_every)
+        res = ckpt.run_with_restarts(
+            run_once, max_restarts=args.max_restarts)
         print(f"The original graph has {res.n_paths} paths "
               f"({res.n_rounds} rounds)")
 
     elif args.cmd == "als":
         from tpu_distalg.models import als as m
+        from tpu_distalg.utils import checkpoint as ckpt
 
-        res = m.fit(_mesh(args), m.ALSConfig(
-            lam=args.lam, m=args.m, n=args.n, k=args.k,
-            n_iterations=args.n_iterations))
+        mesh = _mesh(args)
+        res = ckpt.run_with_restarts(
+            lambda: m.fit(mesh, m.ALSConfig(
+                lam=args.lam, m=args.m, n=args.n, k=args.k,
+                n_iterations=args.n_iterations),
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every),
+            max_restarts=args.max_restarts)
         for t, e in enumerate(res.rmse_history):
             print(f"iterations: {t}, rmse: {float(e):f}")
 
     elif args.cmd == "mc":
         from tpu_distalg.models import monte_carlo as m
+        from tpu_distalg.utils import checkpoint as ckpt
 
-        pi, n_used = m.estimate_pi(
-            _mesh(args), m.MonteCarloConfig(n=args.n))
+        mesh = _mesh(args)
+        pi, n_used = ckpt.run_with_restarts(
+            lambda: m.estimate_pi(mesh, m.MonteCarloConfig(n=args.n)),
+            max_restarts=args.max_restarts)
         print(f"Pi is roughly {pi:f}")
 
     return 0

@@ -61,22 +61,25 @@ def init_centers(points: np.ndarray, k: int, seed: int) -> np.ndarray:
     return np.asarray(points)[idx].astype(np.float32)
 
 
-def _lloyd_loop(one_iter, config: KMeansConfig, centers0):
-    """Shared Lloyd driver: fixed iterations (reference parity) or the
-    real ``converge_dist`` check; ``one_iter(centers) -> centers``.
-    Returns (final centers, iterations run)."""
+def _seg_loop(one_iter, config: KMeansConfig, seg: int,
+              centers0, shift0, n_run0):
+    """THE Lloyd loop — both the straight driver (one full-length
+    segment) and every checkpoint segment run this exact code, so the
+    segmented==straight bitwise contract cannot drift. Fixed-iteration
+    mode runs exactly ``seg``; converge mode caps the while_loop at
+    ``seg`` more iterations, and because the carried ``shift``
+    re-enters the loop condition, post-convergence segments are
+    no-ops. Returns ``(centers, shift, n_run)``."""
     if config.converge_dist is None:
         centers, _ = jax.lax.scan(
             lambda c, _: (one_iter(c), None), centers0, None,
-            length=config.n_iterations,
+            length=seg,
         )
-        return centers, config.n_iterations
+        return centers, shift0, n_run0 + seg
 
     def cond(state):
         _, shift, it = state
-        return (shift > config.converge_dist) & (
-            it < config.max_iterations
-        )
+        return (shift > config.converge_dist) & (it < seg)
 
     def body(state):
         centers, _, it = state
@@ -84,9 +87,21 @@ def _lloyd_loop(one_iter, config: KMeansConfig, centers0):
         shift = jnp.sum(jnp.sqrt(jnp.sum((new - centers) ** 2, axis=1)))
         return new, shift, it + 1
 
-    centers, _, n_run = jax.lax.while_loop(
-        cond, body, (centers0, jnp.float32(jnp.inf), 0)
+    centers, shift, it = jax.lax.while_loop(
+        cond, body, (centers0, shift0, jnp.int32(0))
     )
+    return centers, shift, n_run0 + it
+
+
+def _lloyd_loop(one_iter, config: KMeansConfig, centers0):
+    """Straight Lloyd driver = one full-length segment of
+    :func:`_seg_loop`; ``one_iter(centers) -> centers``. Returns
+    (final centers, iterations run)."""
+    n_total = (config.n_iterations if config.converge_dist is None
+               else config.max_iterations)
+    centers, _, n_run = _seg_loop(
+        one_iter, config, n_total, centers0,
+        jnp.float32(jnp.inf), jnp.int32(0))
     return centers, n_run
 
 
@@ -233,10 +248,86 @@ def init_centers_farthest(make_rows, n_rows: int, k: int, seed: int,
     return jnp.asarray(cand[chosen])
 
 
+def make_fit_seg_fn(mesh: Mesh, config: KMeansConfig, seg: int):
+    """One compiled checkpoint segment: up to ``seg`` Lloyd iterations
+    continuing from ``(centers, shift, n_run)`` — the same
+    :func:`_seg_loop` the straight driver runs (the checkpoint/resume
+    bitwise contract every optimizer workload has)."""
+    stats_fn = data_parallel(
+        _local_stats, mesh,
+        in_specs=(P("data", None), P("data"), P()),
+        out_specs=(P(), P(), P("data")),
+    )
+
+    def seg_run(points, mask, centers0, shift0, n_run0):
+        def one_iter(centers):
+            sums, counts, _ = stats_fn(points, mask, centers)
+            return kops.update_centers(sums, counts, centers)
+
+        return _seg_loop(one_iter, config, seg, centers0, shift0,
+                         n_run0)
+
+    return jax.jit(seg_run)
+
+
+def _fit_segmented(data, mask, mesh, config: KMeansConfig, centers0,
+                   checkpoint_dir: str, checkpoint_every: int):
+    """Checkpointed Lloyd driver (state is tiny: the (k, dim) centers
+    plus the convergence carry) — the task-retry capability Spark gives
+    the reference's k-means for free (SURVEY.md §5)."""
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    converge = config.converge_dist is not None
+    n_total = config.max_iterations if converge else config.n_iterations
+    stop_when = (
+        (lambda s: float(s["shift"]) <= config.converge_dist)
+        if converge else None)
+
+    def run_seg(fn, state, t0):
+        centers, shift, n_run = fn(
+            data, mask, state["centers"], state["shift"],
+            state["n_run"])
+        new = {"centers": centers, "shift": shift, "n_run": n_run}
+        return new, np.asarray(shift, np.float32)[None]
+
+    state0 = {
+        "centers": jnp.asarray(centers0),
+        # fixed mode never updates shift — keep it finite for the
+        # segment-boundary non-finite guard; converge mode starts at
+        # inf exactly like the straight while_loop
+        "shift": jnp.float32(np.inf if converge else 0.0),
+        "n_run": jnp.int32(0),
+    }
+    state, _, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, n_total,
+        lambda seg: make_fit_seg_fn(mesh, config, seg),
+        run_seg, state0,
+        # the two modes share the state signature but fixed mode's
+        # shift=0.0 sentinel would alias "converged" on a cross-mode
+        # resume — encode the mode in the tag
+        tag="kmeans_converge" if converge else "kmeans_fixed",
+        stop_when=stop_when)
+
+    assign_fn = jax.jit(data_parallel(
+        lambda p, m, c: kops.assign_clusters(p, c), mesh,
+        in_specs=(P("data", None), P("data"), P()),
+        out_specs=P("data")))
+    centers = state["centers"]
+    return KMeansResult(
+        centers=centers, assignments=assign_fn(data, mask, centers),
+        n_iterations_run=int(state["n_run"]),
+    )
+
+
 def fit(points: np.ndarray, mesh: Mesh,
-        config: KMeansConfig = KMeansConfig()) -> KMeansResult:
+        config: KMeansConfig = KMeansConfig(), *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 100) -> KMeansResult:
     ps = parallelize(points, mesh)
     centers0 = init_centers(points, config.k, config.seed)
+    if checkpoint_dir is not None:
+        return _fit_segmented(ps.data, ps.mask, mesh, config, centers0,
+                              checkpoint_dir, checkpoint_every)
     fn = make_fit_fn(mesh, config)
     centers, assign, n_run = fn(ps.data, ps.mask, jnp.asarray(centers0))
     return KMeansResult(
@@ -258,7 +349,9 @@ def init_centers_scaled(make_rows, n_rows: int,
 
 
 def fit_scaled(mesh: Mesh, n_rows: int, make_rows,
-               config: KMeansConfig = KMeansConfig()) -> KMeansResult:
+               config: KMeansConfig = KMeansConfig(), *,
+               checkpoint_dir: str | None = None,
+               checkpoint_every: int = 100) -> KMeansResult:
     """Scale-out fit: the dataset is synthesized ON DEVICE, shard by
     shard (``parallel.build_sharded``), and the init centers are
     regenerated from k row ids — host memory is O(k) in ``n_rows``,
@@ -271,6 +364,9 @@ def fit_scaled(mesh: Mesh, n_rows: int, make_rows,
 
     ps = build_sharded(mesh, n_rows, make_rows)
     centers0 = init_centers_scaled(make_rows, n_rows, config)
+    if checkpoint_dir is not None:
+        return _fit_segmented(ps.data, ps.mask, mesh, config, centers0,
+                              checkpoint_dir, checkpoint_every)
     fn = make_fit_fn(mesh, config)
     centers, assign, n_run = fn(ps.data, ps.mask, centers0)
     return KMeansResult(

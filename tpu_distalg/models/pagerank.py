@@ -244,8 +244,15 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
             out_specs=(P(), P()),
         )
 
-        def run(src, dst, w_e, emask, has_out, n_ref):
-            ranks0 = jnp.where(has_out > 0, 1.0 / n_ref, 0.0)  # :47
+        def run(src, dst, w_e, emask, has_out, n_ref,
+                ranks0=None, has_rank0=None):
+            # optional carry-in: the checkpointed driver resumes the
+            # power iteration mid-schedule (iterations are
+            # time-invariant, so segmenting the scan is bitwise-exact)
+            if ranks0 is None:
+                ranks0 = jnp.where(has_out > 0, 1.0 / n_ref, 0.0)  # :47
+            if has_rank0 is None:
+                has_rank0 = has_out
 
             def step(carry, _):
                 ranks, has_rank = carry
@@ -258,7 +265,7 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
                 return (ranks, new_has), None
 
             (ranks, has_rank), _ = jax.lax.scan(
-                step, (ranks0, has_out), None,
+                step, (ranks0, has_rank0), None,
                 length=config.n_iterations,
             )
             return ranks, has_rank
@@ -286,9 +293,11 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
             out_specs=P(),
         )
 
-        def run(src, dst, w_e, emask, has_out, n_ref):
-            del dst, emask, n_ref  # plan arrays encode the padded dst
-            ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+        def run(src, dst, w_e, emask, has_out, n_ref,
+                ranks0=None, has_rank0=None):
+            del dst, emask, n_ref, has_rank0  # plan encodes padded dst
+            if ranks0 is None:
+                ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
 
             def step(ranks, _):
                 acc = sweep_fn(src, w_e, plan.base, plan.row,
@@ -319,9 +328,11 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
         out_specs=P(),
     )
 
-    def run(src, dst, w_e, emask, has_out, n_ref):
-        del emask, n_ref  # padding already carries zero weight
-        ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+    def run(src, dst, w_e, emask, has_out, n_ref,
+            ranks0=None, has_rank0=None):
+        del emask, n_ref, has_rank0  # padding already carries 0 weight
+        if ranks0 is None:
+            ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
 
         def step(ranks, _):
             c = sweep_fn(src, dst, w_e, ranks)
@@ -341,14 +352,62 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
 
 def run(edges: np.ndarray, mesh: Mesh,
         config: PageRankConfig = PageRankConfig(),
-        n_vertices: int | None = None) -> PageRankResult:
+        n_vertices: int | None = None, *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5) -> PageRankResult:
     el = gops.prepare_edges(edges, n_vertices)
     de = prepare_device_edges(
         el, mesh,
         build_plan=(config.mode == "standard"
                     and config.scatter != "xla"))
+    if checkpoint_dir is not None:
+        return _run_segmented(de, mesh, config, checkpoint_dir,
+                              checkpoint_every)
     fn = make_run_fn(mesh, config, de.n_vertices, de.plan)
     ranks, has_rank = fn(
         de.src, de.dst, de.w_e, de.emask, de.has_out, de.n_ref
     )
     return PageRankResult(ranks=ranks, has_rank=has_rank)
+
+
+def _run_segmented(de: DeviceEdges, mesh: Mesh, config: PageRankConfig,
+                   checkpoint_dir: str,
+                   checkpoint_every: int) -> PageRankResult:
+    """Checkpointed power iteration (state is the (V,) rank vector plus
+    the reference mode's has_rank mask). Iterations are time-invariant,
+    so resuming a saved carry is bitwise-identical to an uninterrupted
+    scan — replacing the Spark task-retry the reference's
+    10-join-deep lineage gets for free
+    (``graph_computation/pagerank.py:52-57``)."""
+    import dataclasses as dc
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    V = de.n_vertices
+    if config.mode == "reference":
+        ranks0 = jnp.where(de.has_out > 0, 1.0 / de.n_ref, 0.0)
+        has_rank0 = de.has_out
+    else:
+        ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+        has_rank0 = jnp.ones((V,), dtype=jnp.float32)
+
+    def make_seg_fn(seg):
+        return make_run_fn(mesh, dc.replace(config, n_iterations=seg),
+                           V, de.plan)
+
+    def run_seg(fn, state, t0):
+        ranks, has_rank = fn(de.src, de.dst, de.w_e, de.emask,
+                             de.has_out, de.n_ref,
+                             state["ranks"], state["has_rank"])
+        return ({"ranks": ranks, "has_rank": has_rank},
+                np.asarray(jnp.sum(ranks), np.float32)[None])
+
+    state, _, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn, run_seg,
+        {"ranks": ranks0, "has_rank": has_rank0},
+        # both modes carry the same (V,) f32 pair, so the shape check
+        # alone cannot catch a cross-mode resume — encode the mode
+        tag=f"pagerank_{config.mode}")
+    return PageRankResult(ranks=jnp.asarray(state["ranks"]),
+                          has_rank=jnp.asarray(state["has_rank"]))

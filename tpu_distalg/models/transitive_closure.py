@@ -64,7 +64,9 @@ class SparseClosureResult:
 
 def run(edges: np.ndarray, mesh: Mesh,
         config: ClosureConfig = ClosureConfig(),
-        n_vertices: int | None = None) -> ClosureResult:
+        n_vertices: int | None = None, *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 8) -> ClosureResult:
     el = gops.prepare_edges(edges, n_vertices)
     n_shards = mesh.shape[DATA_AXIS]
     # pad vertex count so path-matrix rows shard evenly; padded vertices are
@@ -75,35 +77,65 @@ def run(edges: np.ndarray, mesh: Mesh,
     adj = np.zeros((V, V), dtype=bool)
     adj[el.src, el.dst] = True
     rows = data_sharding(mesh, ndim=2)
+    edges_bool = jnp.asarray(adj)
 
-    @jax.jit
-    def fixpoint(edges_bool):
-        paths0 = edges_bool  # paths start as the edge set (:18-27)
-        cnt0 = gops.path_count(paths0)
+    def make_seg_fn(seg):
+        # one compiled segment: up to ``seg`` more rounds from the
+        # carried (paths, old_cnt, cnt, it). With seg=cap this IS the
+        # straight fixpoint; smaller seg inserts checkpoint boundaries
+        # without changing the round sequence (bitwise-identical).
+        @jax.jit
+        def seg_fix(eb, paths, old_cnt, cnt, it):
+            it_hi = jnp.minimum(it + seg, cap)
 
-        def cond(state):
-            _, old_cnt, cnt, it = state
-            return (cnt != old_cnt) & (it < cap)
+            def cond(state):
+                _, old, c, i = state
+                return (c != old) & (i < it_hi)
 
-        def body(state):
-            paths, _, cnt, it = state
-            new_paths = gops.closure_step(paths, edges_bool)
-            new_paths = lax.with_sharding_constraint(new_paths, rows)
-            return new_paths, cnt, gops.path_count(new_paths), it + 1
+            def body(state):
+                paths, _, c, i = state
+                new_paths = gops.closure_step(paths, eb)
+                new_paths = lax.with_sharding_constraint(new_paths, rows)
+                return new_paths, c, gops.path_count(new_paths), i + 1
 
-        return lax.while_loop(
-            cond, body, (paths0, jnp.int32(-1), cnt0, jnp.int32(0))
+            return lax.while_loop(cond, body, (paths, old_cnt, cnt, it))
+
+        return seg_fix
+
+    state0 = (edges_bool, jnp.int32(-1),  # paths start as the edge set
+              gops.path_count(edges_bool), jnp.int32(0))
+
+    if checkpoint_dir is None:
+        paths, _, cnt, rounds = make_seg_fn(cap)(edges_bool, *state0)
+        return ClosureResult(
+            paths=paths, n_paths=int(cnt), n_rounds=int(rounds)
         )
 
-    paths, _, cnt, rounds = fixpoint(jnp.asarray(adj))
-    return ClosureResult(
-        paths=paths, n_paths=int(cnt), n_rounds=int(rounds)
-    )
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    def run_seg(fn, state, t0):
+        paths, old, cnt, it = fn(edges_bool, state["paths"],
+                                 state["old"], state["cnt"],
+                                 state["it"])
+        new = {"paths": paths, "old": old, "cnt": cnt, "it": it}
+        return new, np.asarray(cnt, np.float32)[None]
+
+    state, _, _ = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, cap, make_seg_fn, run_seg,
+        {"paths": state0[0], "old": state0[1], "cnt": state0[2],
+         "it": state0[3]},
+        tag="closure_dense",
+        stop_when=lambda s: int(s["cnt"]) == int(s["old"]))
+    return ClosureResult(paths=jnp.asarray(state["paths"]),
+                         n_paths=int(state["cnt"]),
+                         n_rounds=int(state["it"]))
 
 
 def run_sparse(edges: np.ndarray, mesh: Mesh,
                config: SparseClosureConfig = SparseClosureConfig(),
-               n_vertices: int | None = None) -> SparseClosureResult:
+               n_vertices: int | None = None, *,
+               checkpoint_dir: str | None = None,
+               checkpoint_every: int = 8) -> SparseClosureResult:
     """Transitive closure without the V×V matrix — O(closure size) memory.
 
     The dense fixpoint (:func:`run`) is the right shape for small/dense
@@ -173,71 +205,107 @@ def run_sparse(edges: np.ndarray, mesh: Mesh,
     off_d = jnp.asarray(offsets[: V + 1].astype(np.int32))
     dst_d = jnp.asarray(el.dst)                      # src-sorted
 
-    @jax.jit
-    def fixpoint(px, pz, deg, off, dst):
-        def count_valid(x):
-            return jnp.sum((x < V).astype(jnp.int32))
+    def make_seg_fn(seg):
+        # one compiled segment of up to ``seg`` more rounds from the
+        # carried fixpoint state; seg=cap is the straight run, smaller
+        # seg adds checkpoint boundaries (bitwise-identical rounds)
+        @jax.jit
+        def fixpoint(px, pz, old_cnt0, cnt0, it0, overflow0,
+                     deg, off, dst):
+            it_hi = jnp.minimum(it0 + seg, cap)
 
-        def cond(state):
-            _, _, old_cnt, cnt, it, overflow = state
-            # ~overflow: fail fast — once a round overflows its buffers the
-            # result can never be trusted, so don't pay the remaining rounds
-            return (cnt != old_cnt) & (it < cap) & ~overflow
+            def count_valid(x):
+                return jnp.sum((x < V).astype(jnp.int32))
 
-        def body(state):
-            px, pz, _, cnt, it, overflow = state
-            # join (x,y) ⋈ edges(y,·) via segmented expand: path p owns
-            # candidate slots [start_p, start_p + deg(pz_p))
-            k = deg[pz]                              # (C,)
-            start = jnp.cumsum(k) - k                # exclusive prefix
-            K = start[-1] + k[-1]                    # true join size
-            # K is int32 and can wrap when the true join exceeds 2^31. The
-            # exact K > J test catches every non-wrapping overflow; K < 0
-            # catches true sizes in (2^31, 2^32); the f32 sum catches
-            # >= 2^32 wrap-to-positive. Kf is compared against 2^31 (not J)
-            # because the tree-reduction rounding of the f32 sum could
-            # otherwise spuriously trip on a valid round with K ~ J.
-            Kf = jnp.sum(k.astype(jnp.float32))
-            overflow = (overflow | (K > J) | (K < 0)
-                        | (Kf > jnp.float32(2**31)))
-            # mark slot start_p with p+1 (k>0 paths only), cummax fills
-            # the segment; -1 → owning path id
-            marks = jnp.zeros((J,), jnp.int32).at[
-                jnp.where(k > 0, start, J)
-            ].max(jnp.arange(C, dtype=jnp.int32) + 1, mode="drop")
-            pid = jax.lax.cummax(marks) - 1          # (J,)
-            slot = jnp.arange(J, dtype=jnp.int32)
-            valid = (slot < K) & (pid >= 0)
-            pid = jnp.where(valid, pid, 0)
-            rank = slot - start[pid]
-            eidx = jnp.clip(off[pz[pid]] + rank, 0, max(E - 1, 0))
-            cx = jnp.where(valid, px[pid], V)
-            cz = jnp.where(valid, dst[eidx], V) if E else jnp.full(
-                (J,), V, jnp.int32)
-            ax = jnp.concatenate([px, cx])           # union
-            az = jnp.concatenate([pz, cz])
-            ax, az = jax.lax.sort((ax, az), num_keys=2)
-            dup = jnp.concatenate([
-                jnp.zeros((1,), bool),
-                (ax[1:] == ax[:-1]) & (az[1:] == az[:-1]),
-            ])
-            uniq = (ax < V) & ~dup                   # distinct
-            ax = jnp.where(uniq, ax, V)
-            az = jnp.where(uniq, az, V)
-            ax, az = jax.lax.sort((ax, az), num_keys=2)  # compact
-            new_cnt = count_valid(ax)
-            overflow = overflow | (new_cnt > C)
-            return (ax[:C], az[:C], cnt, jnp.minimum(new_cnt, C),
-                    it + 1, overflow)
+            def cond(state):
+                _, _, old_cnt, cnt, it, overflow = state
+                # ~overflow: fail fast — once a round overflows its
+                # buffers the result can never be trusted, so don't pay
+                # the remaining rounds
+                return (cnt != old_cnt) & (it < it_hi) & ~overflow
 
-        cnt0 = count_valid(px)
-        return jax.lax.while_loop(
-            cond, body,
-            (px, pz, jnp.int32(-1), cnt0, jnp.int32(0), jnp.bool_(False)),
-        )
+            def body(state):
+                px, pz, _, cnt, it, overflow = state
+                # join (x,y) ⋈ edges(y,·) via segmented expand: path p owns
+                # candidate slots [start_p, start_p + deg(pz_p))
+                k = deg[pz]                              # (C,)
+                start = jnp.cumsum(k) - k                # exclusive prefix
+                K = start[-1] + k[-1]                    # true join size
+                # K is int32 and can wrap when the true join exceeds 2^31. The
+                # exact K > J test catches every non-wrapping overflow; K < 0
+                # catches true sizes in (2^31, 2^32); the f32 sum catches
+                # >= 2^32 wrap-to-positive. Kf is compared against 2^31 (not J)
+                # because the tree-reduction rounding of the f32 sum could
+                # otherwise spuriously trip on a valid round with K ~ J.
+                Kf = jnp.sum(k.astype(jnp.float32))
+                overflow = (overflow | (K > J) | (K < 0)
+                            | (Kf > jnp.float32(2**31)))
+                # mark slot start_p with p+1 (k>0 paths only), cummax fills
+                # the segment; -1 → owning path id
+                marks = jnp.zeros((J,), jnp.int32).at[
+                    jnp.where(k > 0, start, J)
+                ].max(jnp.arange(C, dtype=jnp.int32) + 1, mode="drop")
+                pid = jax.lax.cummax(marks) - 1          # (J,)
+                slot = jnp.arange(J, dtype=jnp.int32)
+                valid = (slot < K) & (pid >= 0)
+                pid = jnp.where(valid, pid, 0)
+                rank = slot - start[pid]
+                eidx = jnp.clip(off[pz[pid]] + rank, 0, max(E - 1, 0))
+                cx = jnp.where(valid, px[pid], V)
+                cz = jnp.where(valid, dst[eidx], V) if E else jnp.full(
+                    (J,), V, jnp.int32)
+                ax = jnp.concatenate([px, cx])           # union
+                az = jnp.concatenate([pz, cz])
+                ax, az = jax.lax.sort((ax, az), num_keys=2)
+                dup = jnp.concatenate([
+                    jnp.zeros((1,), bool),
+                    (ax[1:] == ax[:-1]) & (az[1:] == az[:-1]),
+                ])
+                uniq = (ax < V) & ~dup                   # distinct
+                ax = jnp.where(uniq, ax, V)
+                az = jnp.where(uniq, az, V)
+                ax, az = jax.lax.sort((ax, az), num_keys=2)  # compact
+                new_cnt = count_valid(ax)
+                overflow = overflow | (new_cnt > C)
+                return (ax[:C], az[:C], cnt, jnp.minimum(new_cnt, C),
+                        it + 1, overflow)
 
-    px, pz, _, cnt, rounds, overflow = fixpoint(
-        px0, pz0, deg_d, off_d, dst_d)
+            return jax.lax.while_loop(
+                cond, body,
+                (px, pz, old_cnt0, cnt0, it0, overflow0),
+            )
+
+        return fixpoint
+
+    cnt0 = jnp.int32(E)  # every buffer entry < V is a real edge
+    state0 = (px0, pz0, jnp.int32(-1), cnt0, jnp.int32(0),
+              jnp.bool_(False))
+
+    if checkpoint_dir is None:
+        px, pz, _, cnt, rounds, overflow = make_seg_fn(cap)(
+            *state0, deg_d, off_d, dst_d)
+    else:
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        def run_seg(fn, state, t0):
+            px, pz, old, cnt, it, ov = fn(
+                state["px"], state["pz"], state["old"], state["cnt"],
+                state["it"], state["ov"], deg_d, off_d, dst_d)
+            new = {"px": px, "pz": pz, "old": old, "cnt": cnt,
+                   "it": it, "ov": ov}
+            return new, np.asarray(cnt, np.float32)[None]
+
+        state, _, _ = ckpt.run_segmented(
+            checkpoint_dir, checkpoint_every, cap, make_seg_fn,
+            run_seg,
+            {"px": state0[0], "pz": state0[1], "old": state0[2],
+             "cnt": state0[3], "it": state0[4], "ov": state0[5]},
+            tag="closure_sparse",
+            stop_when=lambda s: (bool(s["ov"])
+                                 or int(s["cnt"]) == int(s["old"])))
+        px, pz = jnp.asarray(state["px"]), jnp.asarray(state["pz"])
+        cnt, rounds, overflow = state["cnt"], state["it"], state["ov"]
+
     n_paths = int(cnt)
     if bool(overflow):
         raise ValueError(

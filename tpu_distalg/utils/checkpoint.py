@@ -92,6 +92,7 @@ def run_segmented(
     *,
     tag: str = "",
     keep: int = 3,
+    stop_when=None,
 ):
     """Generic segmented/resumable training loop — the machinery behind
     every workload's ``checkpoint_dir`` option.
@@ -109,7 +110,13 @@ def run_segmented(
     ``tag`` names the workload — stored in every checkpoint and compared
     on resume (along with the state leaves' shapes/dtypes), so resuming
     the wrong workload's directory fails loudly instead of silently
-    continuing from foreign weights. Returns
+    continuing from foreign weights. ``stop_when(state)`` (optional) is
+    checked after every segment AND on resume: fixpoint workloads
+    (k-means converge mode, closure, ALS-to-tolerance) stop as soon as
+    their convergence predicate holds instead of burning no-op segments
+    to ``n_iterations`` — the segment bodies must make post-convergence
+    segments no-ops (carry their convergence signal in ``state``) so
+    segmented and straight runs stay bitwise-identical. Returns
     ``(state, accs_concat, start_step)``.
     """
     if checkpoint_every < 1:
@@ -158,6 +165,8 @@ def run_segmented(
     seg_fns = {}
     t = start
     while t < n_iterations:
+        if stop_when is not None and stop_when(state):
+            break
         seg = min(checkpoint_every, n_iterations - t)
         if seg not in seg_fns:
             seg_fns[seg] = make_seg_fn(seg)
