@@ -157,10 +157,12 @@ def main(argv=None):
     p.add_argument("--mode", default="reference",
                    choices=["reference", "standard"])
     p.add_argument("--scatter", default="auto",
-                   choices=["auto", "pallas", "xla"],
-                   help="standard-mode scatter path: the Pallas windowed "
-                        "one-hot-MXU kernel (when the graph admits a "
-                        "window plan) or the XLA segment_sum")
+                   choices=["auto", "pallas", "xla", "spmv"],
+                   help="standard-mode sweep path: the Pallas windowed "
+                        "one-hot-MXU scatter (when the graph admits a "
+                        "window plan), the XLA segment_sum, or the "
+                        "fully-fused tiled SpMV kernel ('spmv': gather "
+                        "AND scatter in one Pallas launch)")
     p.add_argument("--n-vertices", type=int, default=0,
                    help="0 = the reference's 4-edge toy graph; else an "
                         "Erdős–Rényi graph of this many vertices")

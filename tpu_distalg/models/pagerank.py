@@ -96,6 +96,25 @@ class DevicePlan:
 
 
 @dataclasses.dataclass
+class DeviceSpMV:
+    """Device-resident :class:`ops.pallas_pagerank.SpMVPlan` arrays —
+    the fully-fused Path E sweep (``scatter='spmv'``)."""
+
+    gbase: jax.Array      # (NCH,) int32, sharded over data
+    sbase: jax.Array      # (NCH,) int32
+    src_lane: jax.Array   # (NCH*8, 128) int32
+    src_row: jax.Array    # (NCH*8, 128) int32
+    dst_row: jax.Array    # (NCH*8, 128) int32
+    dst_lane: jax.Array   # (NCH*8, 128) int32
+    w_e: jax.Array        # (NCH*8, 128) f32
+    rg: int
+    ws: int
+    r8: int
+    blk: int
+    n_chunks: int
+
+
+@dataclasses.dataclass
 class DeviceEdges:
     """dst-sorted, mesh-sharded edge arrays + static per-edge weights."""
 
@@ -108,6 +127,43 @@ class DeviceEdges:
     n_vertices: int
     n_ref: float        # reference's n = #vertices with out-links (:41-44)
     plan: DevicePlan | None = None  # Pallas scatter prep (standard mode)
+    spmv: DeviceSpMV | None = None  # fused Path E prep (scatter='spmv')
+
+
+def _inv_out_degree(el: gops.EdgeList) -> np.ndarray:
+    """Per-vertex 1/out_degree (0 for sinks) — THE per-edge weight
+    definition, shared by every sweep path so they cannot diverge."""
+    deg = el.out_degree.astype(np.float32)
+    return np.where(deg > 0, 1.0 / np.maximum(deg, 1.0),
+                    0.0).astype(np.float32)
+
+
+def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
+                        rg: int | None = None) -> DeviceSpMV | None:
+    """Host prep for the fused Path E sweep: two-key edge sort +
+    per-chunk window metadata (``ops/pallas_pagerank.plan_spmv``),
+    device_put sharded over the data axis by chunk blocks. ``None``
+    when the graph's structure exceeds the window caps — callers fall
+    back to the hybrid/XLA sweep."""
+    from tpu_distalg.ops import pallas_pagerank as ppr
+
+    inv_deg = _inv_out_degree(el)
+    n_shards = mesh.shape[DATA_AXIS]
+    kw = {} if rg is None else {"rg": rg}
+    plan = ppr.plan_spmv(el.src, el.dst, inv_deg[el.src],
+                         el.n_vertices, n_shards=n_shards, **kw)
+    if plan is None:
+        return None
+    s1 = data_sharding(mesh, 1)
+    s2 = data_sharding(mesh, 2)
+    put1 = lambda a: jax.device_put(jnp.asarray(a), s1)  # noqa: E731
+    put2 = lambda a: jax.device_put(jnp.asarray(a), s2)  # noqa: E731
+    return DeviceSpMV(
+        gbase=put1(plan.gbase), sbase=put1(plan.sbase),
+        src_lane=put2(plan.src_lane), src_row=put2(plan.src_row),
+        dst_row=put2(plan.dst_row), dst_lane=put2(plan.dst_lane),
+        w_e=put2(plan.w_e), rg=plan.rg, ws=plan.ws, r8=plan.r8,
+        blk=plan.blk, n_chunks=plan.n_chunks)
 
 
 def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
@@ -130,9 +186,7 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
     src_o = el.src[order].astype(np.int32)
     dst_o = el.dst[order].astype(np.int32)
     deg = el.out_degree.astype(np.float32)
-    inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1.0), 0.0).astype(
-        np.float32
-    )
+    inv_deg = _inv_out_degree(el)
     w_e = inv_deg[src_o]
     V = el.n_vertices
     n_shards = mesh.shape[DATA_AXIS]
@@ -190,7 +244,8 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
 
 
 def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
-                plan: DevicePlan | None = None):
+                plan: DevicePlan | None = None,
+                spmv: DeviceSpMV | None = None):
     """Build the jitted n-iteration sweep.
 
     PRECONDITION: the edge arrays passed to the returned ``run`` MUST be
@@ -211,7 +266,7 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
     V = n_vertices
     q = config.q
 
-    if config.scatter not in ("auto", "pallas", "xla"):
+    if config.scatter not in ("auto", "pallas", "xla", "spmv"):
         raise ValueError(f"unknown scatter mode {config.scatter!r}")
     if config.mode != "standard" and config.scatter != "auto":
         raise ValueError(
@@ -219,7 +274,8 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
             "'standard' — the reference-parity mode always uses the "
             "XLA segment_sum path"
         )
-    use_pallas = (config.mode == "standard" and config.scatter != "xla"
+    use_pallas = (config.mode == "standard"
+                  and config.scatter in ("auto", "pallas")
                   and plan is not None)
     if config.mode == "standard" and config.scatter == "pallas" \
             and plan is None:
@@ -227,6 +283,13 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
             "scatter='pallas' needs a scatter plan — the graph's dst "
             "distribution was too sparse/skewed for a bounded window "
             "(ops/pallas_pagerank.plan_scatter returned None)"
+        )
+    if config.mode == "standard" and config.scatter == "spmv" \
+            and spmv is None:
+        raise ValueError(
+            "scatter='spmv' needs the fused-SpMV plan — build the "
+            "DeviceSpMV via prepare_device_spmv (None means the "
+            "graph's windows exceeded ops/pallas_pagerank caps)"
         )
 
     if config.mode == "reference":
@@ -269,6 +332,53 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
                 length=config.n_iterations,
             )
             return ranks, has_rank
+
+        return jax.jit(run)
+
+    if config.mode == "standard" and config.scatter == "spmv":
+        # Path E: the fully-fused tiled SpMV — gather AND scatter in
+        # one Pallas kernel, no XLA random-access op in the sweep
+        from tpu_distalg.ops import pallas_pagerank as ppr
+
+        interpret = next(iter(mesh.devices.flat)).platform != "tpu"
+        rg, ws, r8, blk = spmv.rg, spmv.ws, spmv.r8, spmv.blk
+        pad = (r8 + rg) * 128 - V
+
+        def body(gb, sb, slane, srow, drow, dlane, we, ranks):
+            rt = jnp.pad(ranks, (0, pad)).reshape(r8 + rg, 128)
+            acc = ppr.spmv_table(gb, sb, rt, slane, srow, drow, dlane,
+                                 we, rg=rg, ws=ws, r8=r8, blk=blk,
+                                 interpret=interpret)
+            return tree_allreduce_sum(acc)
+
+        sweep_fn = data_parallel(
+            body, mesh,
+            in_specs=(P("data"), P("data"))
+            + (P("data", None),) * 5 + (P(),),
+            out_specs=P(),
+        )
+
+        def run(src, dst, w_e, emask, has_out, n_ref,
+                ranks0=None, has_rank0=None):
+            del src, dst, w_e, emask, n_ref, has_rank0  # plan-encoded
+            if ranks0 is None:
+                ranks0 = jnp.full((V,), 1.0 / V, dtype=jnp.float32)
+
+            def step(ranks, _):
+                acc = sweep_fn(spmv.gbase, spmv.sbase, spmv.src_lane,
+                               spmv.src_row, spmv.dst_row,
+                               spmv.dst_lane, spmv.w_e, ranks)
+                c = acc[:r8].reshape(-1)[:V]
+                if config.redistribute_dangling:
+                    dangling = jnp.sum(ranks * (1.0 - has_out))
+                    c = c + dangling / V
+                ranks = q / V + (1 - q) * c
+                return ranks, None
+
+            ranks, _ = jax.lax.scan(
+                step, ranks0, None, length=config.n_iterations
+            )
+            return ranks, jnp.ones((V,), dtype=jnp.float32)
 
         return jax.jit(run)
 
@@ -359,11 +469,13 @@ def run(edges: np.ndarray, mesh: Mesh,
     de = prepare_device_edges(
         el, mesh,
         build_plan=(config.mode == "standard"
-                    and config.scatter != "xla"))
+                    and config.scatter in ("auto", "pallas")))
+    if config.mode == "standard" and config.scatter == "spmv":
+        de.spmv = prepare_device_spmv(el, mesh)
     if checkpoint_dir is not None:
         return _run_segmented(de, mesh, config, checkpoint_dir,
                               checkpoint_every)
-    fn = make_run_fn(mesh, config, de.n_vertices, de.plan)
+    fn = make_run_fn(mesh, config, de.n_vertices, de.plan, de.spmv)
     ranks, has_rank = fn(
         de.src, de.dst, de.w_e, de.emask, de.has_out, de.n_ref
     )
@@ -393,7 +505,7 @@ def _run_segmented(de: DeviceEdges, mesh: Mesh, config: PageRankConfig,
 
     def make_seg_fn(seg):
         return make_run_fn(mesh, dc.replace(config, n_iterations=seg),
-                           V, de.plan)
+                           V, de.plan, de.spmv)
 
     def run_seg(fn, state, t0):
         ranks, has_rank = fn(de.src, de.dst, de.w_e, de.emask,

@@ -158,7 +158,8 @@ def packed_dims(d: int, pack: int):
 
 
 def pack_augmented(X, y, valid, *, dtype=jnp.bfloat16, pack: int = 16,
-                   block_rows: int = 8192, shuffle_seed: int | None = None):
+                   block_rows: int = 8192, shuffle_seed: int | None = None,
+                   as_numpy: bool = False):
     """Pack (X, y, valid) for :func:`fused_grad_sum_packed` /
     :func:`fused_grad_sum_gathered` — done ONCE, outside the training scan.
 
@@ -188,7 +189,12 @@ def pack_augmented(X, y, valid, *, dtype=jnp.bfloat16, pack: int = 16,
     out[:n, :d] = X
     out[:n, y_col] = np.asarray(y, np.float32)
     out[:n, v_col] = np.asarray(valid, np.float32)[:n]
-    X2 = jnp.asarray(out.reshape(n_t // pack, pack * d_t), dtype)
+    out2 = out.reshape(n_t // pack, pack * d_t)
+    # as_numpy: HOST-resident packed matrix in the device dtype
+    # (ml_dtypes bf16 is a numpy dtype) — the streamed >HBM path packs
+    # once on host and DMAs sampled blocks per step (ssgd_stream)
+    X2 = (out2.astype(jnp.dtype(dtype)) if as_numpy
+          else jnp.asarray(out2, dtype))
     meta = dict(pack=pack, d_total=d_t, y_col=y_col, v_col=v_col,
                 n_padded=n_t)
     return X2, meta
