@@ -1038,8 +1038,13 @@ def _bench_ring_attention(mesh, n_chips):
         grad = jax.grad(loss, argnums=(0, 1, 2))
 
         def body(qc, _):
-            dq, _, _ = grad(qc, kk, v)
-            return qc + (dq * 0.0).astype(qc.dtype), None
+            # the carry must consume ALL THREE cotangents: with only dq
+            # used, XLA dead-code-eliminates the whole dK/dV kernel and
+            # the "fwd+bwd" rate silently drops the backward's heavier
+            # half (caught: 175 "TFLOP/s" with, 106 fwd-only)
+            dq, dk, dv = grad(qc, kk, v)
+            dead = (jnp.sum(dk) + jnp.sum(dv)) * 0.0
+            return qc + (dq * 0.0 + dead).astype(qc.dtype), None
 
         return jax.jit(
             lambda qq: jax.lax.scan(body, qq, None, length=n_inner)[0])

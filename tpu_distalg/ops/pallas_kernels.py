@@ -354,7 +354,8 @@ def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
                            ew3_ref, eyv_ref, w0_ref, ctr_ref, wout_ref,
                            c_ref, wm_ref, acc_ref, cacc_ref, *,
                            pack: int, eta: float, alpha: float,
-                           n_sampled: int, sel_dtype):
+                           n_sampled: int, sel_dtype,
+                           skip_update: bool = False):
     """v5 body: T SGD steps in ONE kernel launch (see
     :func:`fused_train_gathered`). Grid (T, n_sampled); the weight
     master ``wm`` (P·D, 1) f32 and the bf16 selector ``c`` live in VMEM
@@ -396,6 +397,16 @@ def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
     )                                               # (P, P·D) MXU
     cacc_ref[0, 0] += jnp.sum(v)
 
+    if skip_update:
+        # roofline ablation (bench-only): the full gradient pass with
+        # the serialized end-of-step update chain removed — the A/B
+        # against the real kernel prices that chain exactly
+        @pl.when((t == pl.num_programs(0) - 1) & (i == n_sampled - 1))
+        def _done_abl():
+            wout_ref[:] = wm_ref[:]
+
+        return
+
     @pl.when(i == n_sampled - 1)
     def _update():
         nb = jnp.maximum(cacc_ref[0, 0], 1.0)       # empty-sample guard
@@ -422,13 +433,15 @@ def _train_kernel_gathered(idx_ref, x_ref, msel_ref, s_ref, eye_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("pack", "d_total", "y_col", "v_col",
-                     "gather_block_rows", "eta", "alpha", "interpret"),
+                     "gather_block_rows", "eta", "alpha", "interpret",
+                     "skip_update"),
 )
 def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
                          d_total: int, y_col: int, v_col: int,
                          gather_block_rows: int, eta: float,
                          alpha: float = 0.0, center_tile=None,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         skip_update: bool = False):
     """T block-sampled SGD steps in ONE pallas_call (v5, "megakernel").
 
     The v4 kernel (:func:`fused_grad_sum_gathered`) made HBM traffic
@@ -505,7 +518,8 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
         center_tile = jnp.zeros((P * D, 1), jnp.float32)
     kernel = functools.partial(
         _train_kernel_gathered, pack=P, eta=eta, alpha=alpha,
-        n_sampled=n_sampled, sel_dtype=X2.dtype)
+        n_sampled=n_sampled, sel_dtype=X2.dtype,
+        skip_update=skip_update)
     whole = lambda t, i, s: (0, 0)  # noqa: E731 — resident constants
     wout = pl.pallas_call(
         kernel,
