@@ -473,6 +473,21 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
     contract (the local-update family fuses its ``n_local`` steps into
     one launch per round; valid at dp>1 because local steps touch no
     interconnect).
+
+    Roofline decomposition (r5, measured on one v5e, recorded so the
+    0.8-vs-1.0 HBM fraction isn't re-hypothesized): the serialized
+    end-of-step update chain costs **0.5 µs/step** (A/B against
+    ``skip_update=True``: 43.35 vs 42.86 µs/step — ~1%, NOT the ~10%
+    the r3 pencil guessed), and the per-block grid-cell overhead is
+    negligible at equal bytes (13 / 6 / 3 cells per step via
+    gather_block_rows 8k/16k/32k all land at 0.72-0.74 of the
+    819 GB/s roofline in the same session — 575-590 GB/s effective).
+    The residual ~20-25% is the achievable DMA rate for randomly
+    ordered 2-8 MB block reads plus shared-chip contention
+    (session-dependent: 0.72-0.81 observed across rounds); the
+    sequential-read microbenchmark's 92% does not transfer, and no
+    update-chain restructuring can recover what the DMA engine never
+    delivers.
     """
     P, D = pack, d_total
     n2, pd = X2.shape
