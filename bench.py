@@ -687,7 +687,7 @@ def _bench_ssgd_stream(mesh, n_chips):
     raw_bw = 0.0
     for i in range(3):
         t0 = time.perf_counter()
-        float(trainer._touch(trainer._stage(ids[i])))
+        np.asarray(trainer._touch(trainer._stage(ids[i]))).sum()
         raw_bw = max(raw_bw, trainer.h2d_bytes_per_step
                      / (time.perf_counter() - t0))
 
@@ -977,6 +977,9 @@ def _bench_ring_attention(mesh, n_chips):
     # (the output feeds the next iteration's query, so nothing folds
     # away), which is also the shape a training loop runs the kernel in.
     def chained_fwd(n_inner, **kw):
+        # k/v are ARGS, not closure captures: captured 16-64 MB arrays
+        # become jit constants that upload to the remote compiler at
+        # tunnel speed (minutes at 128k)
         f = data_parallel(
             functools.partial(ring_attention, causal=True, **kw),
             mesh,
@@ -984,11 +987,13 @@ def _bench_ring_attention(mesh, n_chips):
             out_specs=P(DATA_AXIS, None, None),
         )
 
-        def body(qc, _):
-            return f(qc, kk, v).astype(jnp.bfloat16), None
+        def run(qq, kc, vc):
+            def body(qc, _):
+                return f(qc, kc, vc).astype(jnp.bfloat16), None
 
-        return jax.jit(
-            lambda qq: jax.lax.scan(body, qq, None, length=n_inner)[0])
+            return jax.lax.scan(body, qq, None, length=n_inner)[0]
+
+        return jax.jit(run)
 
     S = 32768
     q, kk, v = qkv(S)
@@ -997,10 +1002,10 @@ def _bench_ring_attention(mesh, n_chips):
     xla_fwd = chained_fwd(4, kv_chunk=2048)
     flops = S * S / 2 * d * H * 2 * 2  # causal: S^2/2 keys avg, 2 matmuls
     best, spread = profiling.steps_per_sec(
-        lambda: flash_fwd(q), steps=N_INNER,
+        lambda: flash_fwd(q, kk, v), steps=N_INNER,
         with_stats=True, repeats=N_REPEATS, chain=8)
     xla_best, _ = profiling.steps_per_sec(
-        lambda: xla_fwd(q), steps=4,
+        lambda: xla_fwd(q, kk, v), steps=4,
         with_stats=True, repeats=N_REPEATS, chain=4)
     _emit({
         "metric": "ring_attention_32k_tokens_per_sec_per_chip",
@@ -1037,22 +1042,25 @@ def _bench_ring_attention(mesh, n_chips):
 
         grad = jax.grad(loss, argnums=(0, 1, 2))
 
-        def body(qc, _):
-            # the carry must consume ALL THREE cotangents: with only dq
-            # used, XLA dead-code-eliminates the whole dK/dV kernel and
-            # the "fwd+bwd" rate silently drops the backward's heavier
-            # half (caught: 175 "TFLOP/s" with, 106 fwd-only)
-            dq, dk, dv = grad(qc, kk, v)
-            dead = (jnp.sum(dk) + jnp.sum(dv)) * 0.0
-            return qc + (dq * 0.0 + dead).astype(qc.dtype), None
+        def run(qq, kc, vc):
+            def body(qc, _):
+                # the carry must consume ALL THREE cotangents: with
+                # only dq used, XLA dead-code-eliminates the whole
+                # dK/dV kernel and the "fwd+bwd" rate silently drops
+                # the backward's heavier half (caught: 175 "TFLOP/s"
+                # with, 106 fwd-only)
+                dq, dk, dv = grad(qc, kc, vc)
+                dead = (jnp.sum(dk) + jnp.sum(dv)) * 0.0
+                return qc + (dq * 0.0 + dead).astype(qc.dtype), None
 
-        return jax.jit(
-            lambda qq: jax.lax.scan(body, qq, None, length=n_inner)[0])
+            return jax.lax.scan(body, qq, None, length=n_inner)[0]
+
+        return jax.jit(run)
 
     N_INNER_B = 8
     g = chained_grad(N_INNER_B, use_flash=True)
     b_best, b_spread = profiling.steps_per_sec(
-        lambda: g(q), steps=N_INNER_B, with_stats=True,
+        lambda: g(q, kk, v), steps=N_INNER_B, with_stats=True,
         repeats=N_REPEATS, chain=4)
     fb_flops = flops * 3.5  # fwd + 2.5x bwd (5 tile matmuls vs 2)
     _emit({
@@ -1075,10 +1083,10 @@ def _bench_ring_attention(mesh, n_chips):
     # ---- 128k-token single-chip forward (was README-only) ----
     S128 = 131072
     q, kk, v = qkv(S128)
-    flash_fwd_128 = chained_fwd(4, use_flash=True)  # closes over new kk/v
+    flash_fwd_128 = chained_fwd(4, use_flash=True)
     flops128 = S128 * S128 / 2 * d * H * 2 * 2
     l_best, l_spread = profiling.steps_per_sec(
-        lambda: flash_fwd_128(q), steps=4,
+        lambda: flash_fwd_128(q, kk, v), steps=4,
         with_stats=True, repeats=N_REPEATS, chain=2)
     _emit({
         "metric": "ring_attention_128k_tokens_per_sec_per_chip",

@@ -120,6 +120,19 @@ def main(argv=None):
     p.add_argument("--lam", type=float, default=0.0)
     p.add_argument("--reg-type", default="l2",
                    choices=["none", "l2", "l1", "elastic_net"])
+    p.add_argument("--stream-cache", type=str, default=None,
+                   metavar="PATH",
+                   help="train the streamed >HBM path from a disk-"
+                        "backed packed dataset at PATH (created via "
+                        "utils.datasets.streamed_packed_cache if "
+                        "missing — see --stream-rows); sampled blocks "
+                        "are host-gathered and staged per step "
+                        "(models/ssgd_stream.py). Ignores --sampler/"
+                        "--x-dtype/--shuffle-seed (the cache fixes "
+                        "the bf16 dtype and row layout); rejects "
+                        "--mega-steps.")
+    p.add_argument("--stream-rows", type=int, default=1 << 22,
+                   help="rows to generate when --stream-cache is new")
 
     for name in ("ma", "bmuf", "easgd"):
         p = sub.add_parser(name)
@@ -253,6 +266,35 @@ def _dispatch(args, jax):
                 return m.train(
                     *data, mesh, m.LRConfig(
                         n_iterations=args.n_iterations, eta=args.eta),
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every)
+        elif args.cmd == "ssgd" and args.stream_cache is not None:
+            from tpu_distalg.models import ssgd as m
+            from tpu_distalg.models import ssgd_stream
+            from tpu_distalg.utils import datasets
+
+            if args.mega_steps is not None:
+                raise SystemExit(
+                    "--mega-steps applies to sampler=fused_train only; "
+                    "the streamed path runs one kernel per step")
+            n_shards = int(mesh.shape["data"])
+            X2, meta, (X_te, y_te) = datasets.streamed_packed_cache(
+                args.stream_cache, n_rows=args.stream_rows,
+                n_features=125, n_shards=n_shards,
+                pack=args.fused_pack,
+                gather_block_rows=args.gather_block_rows)
+            cfg = m.SSGDConfig(
+                n_iterations=args.n_iterations, eta=args.eta,
+                mini_batch_fraction=args.mini_batch_fraction,
+                lam=args.lam, reg_type=args.reg_type,
+                fused_pack=args.fused_pack,
+                gather_block_rows=args.gather_block_rows,
+                sampler="fused_gather", shuffle_seed=None,
+                eval_every=max(1, args.n_iterations // 10))
+
+            def run_once():
+                return ssgd_stream.train(
+                    X2, meta, mesh, cfg, X_te, y_te,
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every)
         elif args.cmd == "ssgd":

@@ -169,7 +169,8 @@ def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
 def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
                          plan_chunk: int | None = None,
                          plan_blk: int | None = None,
-                         build_plan: bool = True) -> DeviceEdges:
+                         build_plan: bool = True,
+                         light: bool = False) -> DeviceEdges:
     """One-time host prep: dst-sort (native C++ counting sort), per-edge
     weight gather, pad, shard — plus the Pallas-scatter window plan
     (``ops/pallas_pagerank.plan_scatter``) when the graph admits one.
@@ -182,18 +183,29 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
     from tpu_distalg import native
     from tpu_distalg.ops import pallas_pagerank as ppr
 
-    order = native.counting_sort_perm(el.dst, el.n_vertices)
-    src_o = el.src[order].astype(np.int32)
-    dst_o = el.dst[order].astype(np.int32)
     deg = el.out_degree.astype(np.float32)
     inv_deg = _inv_out_degree(el)
-    w_e = inv_deg[src_o]
     V = el.n_vertices
     n_shards = mesh.shape[DATA_AXIS]
-    E = len(src_o)
     shard1 = data_sharding(mesh, 1)
     put = lambda a: jax.device_put(jnp.asarray(a), shard1)  # noqa: E731
     has_out = (deg > 0).astype(np.float32)
+    if light:
+        # the spmv path deletes src/dst/w_e/emask on its first line —
+        # skip the counting sort, per-edge gather, and the ~16 B/edge
+        # of device uploads entirely; only has_out/n_ref are consumed
+        z = np.zeros(n_shards, np.int32)
+        zf = np.zeros(n_shards, np.float32)
+        return DeviceEdges(
+            src=put(z), dst=put(z), w_e=put(zf), emask=put(zf),
+            inv_deg=jnp.asarray(inv_deg), has_out=jnp.asarray(has_out),
+            n_vertices=V, n_ref=float(has_out.sum()), plan=None)
+
+    order = native.counting_sort_perm(el.dst, el.n_vertices)
+    src_o = el.src[order].astype(np.int32)
+    dst_o = el.dst[order].astype(np.int32)
+    w_e = inv_deg[src_o]
+    E = len(src_o)
 
     kw = {}
     if plan_chunk is not None:
@@ -255,13 +267,15 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
     ``dst`` yields silently wrong rank sums, not an error. Construct the
     inputs via :func:`prepare_device_edges` (or :func:`run`, which does).
 
-    Standard mode with a ``plan`` (and ``config.scatter`` 'auto'/'pallas')
-    runs the hybrid sweep: XLA does the one random op it is good at (the
-    fused ``ranks[src]·w`` gather) and the Pallas windowed one-hot-MXU
-    kernel (``ops/pallas_pagerank``) replaces the segment_sum — measured
-    ~9.2 ns/edge vs ~17 for the XLA-only sweep at 1M×8M on one v5e.
-    ``scatter='pallas'`` without a plan raises; 'xla' forces the legacy
-    path (benchmark A/B).
+    Standard-mode path choice: with an ``spmv`` plan (and scatter
+    'auto'/'spmv') the fully-fused tiled SpMV runs — gather AND
+    scatter in one Pallas kernel, measured ~2.9 ns/edge full-iteration
+    at 1M×8M on one v5e. 'auto' PREFERS it; the hybrid sweep (XLA
+    ``ranks[src]·w`` gather + the windowed one-hot-MXU scatter
+    ``plan``, ~9.2 ns/edge) is the fallback when the spmv windows
+    exceed their caps, and the XLA-only sweep (~17 ns/edge) the final
+    fallback. ``scatter='pallas'``/'spmv' without their plan raise;
+    'xla' forces the legacy path (benchmark A/B).
     """
     V = n_vertices
     q = config.q
@@ -335,9 +349,12 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
 
         return jax.jit(run)
 
-    if config.mode == "standard" and config.scatter == "spmv":
+    if (config.mode == "standard"
+            and config.scatter in ("auto", "spmv")
+            and spmv is not None):
         # Path E: the fully-fused tiled SpMV — gather AND scatter in
-        # one Pallas kernel, no XLA random-access op in the sweep
+        # one Pallas kernel, no XLA random-access op in the sweep.
+        # 'auto' prefers it (measured 3.7x the hybrid sweep at 1Mx8M)
         from tpu_distalg.ops import pallas_pagerank as ppr
 
         interpret = next(iter(mesh.devices.flat)).platform != "tpu"
@@ -466,12 +483,22 @@ def run(edges: np.ndarray, mesh: Mesh,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 5) -> PageRankResult:
     el = gops.prepare_edges(edges, n_vertices)
+    if config.mode == "standard" and config.scatter in ("auto", "spmv"):
+        spmv = prepare_device_spmv(el, mesh)
+    else:
+        spmv = None
     de = prepare_device_edges(
         el, mesh,
+        # the hybrid plan is only needed when it will actually run:
+        # explicit 'pallas', or 'auto' falling back from a failed spmv
         build_plan=(config.mode == "standard"
-                    and config.scatter in ("auto", "pallas")))
-    if config.mode == "standard" and config.scatter == "spmv":
-        de.spmv = prepare_device_spmv(el, mesh)
+                    and (config.scatter == "pallas"
+                         or (config.scatter == "auto"
+                             and spmv is None))),
+        # when the spmv path will run, skip the dst-sort prep + edge
+        # uploads it deletes anyway
+        light=spmv is not None)
+    de.spmv = spmv
     if checkpoint_dir is not None:
         return _run_segmented(de, mesh, config, checkpoint_dir,
                               checkpoint_every)

@@ -170,19 +170,45 @@ class StreamTrainer:
         self.shard_spec = NamedSharding(mesh, P(DATA_AXIS, None, None))
         self._row_offsets = (
             np.arange(n_shards)[:, None] * self.n2_local)
-        # full-array reduction: a partial read must not satisfy it
+        # full-array reduction, PER SHARD (axes 1,2 only): the touch
+        # runs concurrently with the previous step's program, and two
+        # in-flight collective programs can deadlock a rendezvous on
+        # backends that may start them out of order (seen on the CPU
+        # mesh) — so the touch must contain NO cross-device collective.
+        # A partial read must not satisfy it either.
         self._touch = jax.jit(
-            lambda a: jnp.sum(a.astype(jnp.float32)))
+            lambda a: jnp.sum(a.astype(jnp.float32), axis=(1, 2)))
+        # CPU-mesh emulation on few host cores starves the rendezvous
+        # when several multi-device programs are in flight (collective
+        # thunks BLOCK pool workers; a 1-core host then never schedules
+        # the remaining participants) — run one step at a time there.
+        # Pipelining is a hardware-rig concern anyway.
+        self._serialize = (
+            next(iter(mesh.devices.flat)).platform != "tpu")
         self.eval_fn = None
         if config.eval_test:
             if X_test is None:
                 raise ValueError("eval_test=True needs X_test/y_test")
+            from tpu_distalg.parallel import replicated_sharding
+
             d_t = meta["d_total"]
             Xt = np.asarray(X_test, np.float32)
             Xt = np.pad(Xt, ((0, 0), (0, d_t - Xt.shape[1])))
-            Xt, yt = jnp.asarray(Xt), jnp.asarray(y_test)
-            self.eval_fn = jax.jit(
-                lambda w: metrics.binary_accuracy(Xt @ w, yt))
+            # replicate onto the mesh AND pin the eval to per-device
+            # local compute via shard_map: left to GSPMD, a jit over
+            # replicated operands may still partition the matmul and
+            # insert collectives — and any collective program
+            # dispatched concurrently with the pipelined step/touch
+            # programs can deadlock a rendezvous on backends that
+            # start programs out of order (seen on the CPU mesh)
+            repl = replicated_sharding(mesh)
+            Xt = jax.device_put(jnp.asarray(Xt), repl)
+            yt = jax.device_put(jnp.asarray(y_test), repl)
+            self.eval_fn = jax.jit(data_parallel(
+                lambda a, b, w: metrics.binary_accuracy(a @ w, b),
+                mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            ))
+            self._eval_args = (Xt, yt)
         self.h2d_bytes_per_step = int(
             n_shards * n_sampled * self.bp * self.X2.shape[1]
             * self.X2.dtype.itemsize)
@@ -218,9 +244,11 @@ class StreamTrainer:
         for i in range(n_steps):
             nxt = self._stage(ids[i + 1]) if i + 1 < n_steps else None
             w = self.step_fn(staged, w)
+            if self._serialize:
+                jax.block_until_ready(w)
             if self.eval_fn is not None:
                 if ts[i] % cfg.eval_every == 0:
-                    last_acc = self.eval_fn(w)
+                    last_acc = self.eval_fn(*self._eval_args, w)
                 accs.append(last_acc)
             else:
                 accs.append(last_acc)
