@@ -10,10 +10,13 @@ Headline metrics (BASELINE.json):
      (``graph_computation/pagerank.py:50-57`` at benchmark scale).
 
 Additional recorded lines (TPU only): 100M-row SSGD with on-device
-synthesis (host RAM O(1)), the MA/BMUF/EASGD local-step rate (megakernel
-local rounds), 10M-point k-means, 4096×16384 rank-64 ALS, and 32k-token
-causal flash attention — each with spread and, where the workload is
-HBM-bound, its roofline fraction.
+synthesis (host RAM O(1)), 1B-row virtual SSGD (>HBM, regenerated
+rows), 32 GB streamed SSGD (>HBM of real disk bytes), the
+MA/BMUF/EASGD local-step rate (megakernel local rounds), 10M-point
+k-means, 4096×16384 rank-64 ALS (exact recovery AND the noisy
+ridge-regularized instance), and causal flash attention (32k fwd, 32k
+fwd+bwd, 128k fwd, 128k fwd+bwd) — each with spread and, where the
+workload is HBM-bound, its roofline fraction.
 
 On TPU the SSGD step runs the whole-schedule megakernel on single-shard
 meshes (``sampler='fused_train'``: weights in VMEM, update in-kernel,

@@ -144,14 +144,28 @@ def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
     per-chunk window metadata (``ops/pallas_pagerank.plan_spmv``),
     device_put sharded over the data axis by chunk blocks. ``None``
     when the graph's structure exceeds the window caps — callers fall
-    back to the hybrid/XLA sweep."""
+    back to the hybrid/XLA sweep.
+
+    With ``rg=None`` the gather window ESCALATES (128 → 256 → 512
+    rows) until the within-group scatter span fits: the span grows as
+    R²/(rg·E), so larger vertex counts need taller windows — 10M
+    vertices / 80M edges plans at rg=512 (ws=184) where rg=128
+    overflows. Taller windows cost proportionally more unrolled gather
+    rows (and Mosaic compile time: ~3 min at rg=512 vs ~10 s at 128);
+    each escalation re-sorts, so the 512 attempt on an 80M-edge graph
+    spends ~2-3 minutes of host prep. VMEM bounds the table:
+    (r8 + ws + rg) · 512 B must stay under the ~100 MB budget, which
+    holds to ~11M vertices."""
     from tpu_distalg.ops import pallas_pagerank as ppr
 
     inv_deg = _inv_out_degree(el)
     n_shards = mesh.shape[DATA_AXIS]
-    kw = {} if rg is None else {"rg": rg}
-    plan = ppr.plan_spmv(el.src, el.dst, inv_deg[el.src],
-                         el.n_vertices, n_shards=n_shards, **kw)
+    plan = None
+    for r in ((rg,) if rg is not None else (ppr.SPMV_RG, 256, 512)):
+        plan = ppr.plan_spmv(el.src, el.dst, inv_deg[el.src],
+                             el.n_vertices, n_shards=n_shards, rg=r)
+        if plan is not None:
+            break
     if plan is None:
         return None
     s1 = data_sharding(mesh, 1)

@@ -69,7 +69,11 @@ class SSGDConfig:
     # 'virtual' = NO resident dataset: sampled blocks are regenerated
     # on device from the counter-based row generator each step, so the
     # logical row count is unbounded by HBM (build via
-    # models/ssgd_virtual.make_train_fn — the >HBM path).
+    # models/ssgd_virtual.make_train_fn). For >HBM datasets of REAL
+    # bytes (host RAM / disk memmap, not a row-id function) use the
+    # streamed trainer instead: models/ssgd_stream.train stages the
+    # sampled blocks host→device per step, double-buffered, and is
+    # bitwise-identical to 'fused_gather' on a resident copy.
     # Precision note: with x_dtype='bfloat16' the fused kernels cast the
     # residual AND the selector-replicated weights to bf16 (the XLA bf16
     # path keeps both f32) — a small extra deviation; convergence to the

@@ -102,7 +102,14 @@ MAX_W = 4         # widest row window: 8*W rows; beyond -> fall back
 # ---- Path E (the fully-fused tiled SpMV) geometry ----
 # rg=128 measured 1.5-1.75 ns/edge at 1M×8M on one v5e vs 2.1-2.4 for
 # rg=64 (ws shrinks 168 -> 80: the 8 per-sublane scatter builds cost
-# more than the extra 64 unrolled gather rows save)
+# more than the extra 64 unrolled gather rows save).
+# Scale law: the within-group scatter span grows as R²/(rg·E) rows, so
+# bigger graphs need taller gather windows — 10M×80M plans at rg=512
+# (ws=184; numerics verified on hardware, 1.5e-7) where rg=128
+# overflows; models/pagerank.prepare_device_spmv escalates rg
+# automatically. Costs at rg=512: ~50 s host sort per attempt and
+# ~3 min Mosaic compile (the gather row-loop unrolls rg iterations).
+# VMEM bounds the whole path at ~11M vertices (table + acc ≈ 81 MB).
 SPMV_RG = 128      # gather window rows (vertices / window = rg*128)
 SPMV_WS_CAP = 192  # max scatter window rows before falling back
 SPMV_BLK = 8       # chunks per grid step
