@@ -1135,11 +1135,31 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    import sys
+
     import jax
 
     from tpu_distalg.parallel import get_mesh
 
-    mesh = get_mesh()
+    # a tunneled TPU backend can be transiently UNAVAILABLE (observed:
+    # ~tens of minutes); retry init instead of dying with no artifact.
+    # 40 x 60 s covers the observed outages while staying inside the
+    # 3600 s watchdog (which handles the init-HANGS-forever mode).
+    mesh = None
+    n_attempts = 40
+    for attempt in range(n_attempts):
+        try:
+            mesh = get_mesh()
+            break
+        except Exception as e:  # noqa: BLE001 — backend init only
+            print(f"[bench] backend init failed "
+                  f"(attempt {attempt + 1}/{n_attempts}): {e}",
+                  file=sys.stderr)
+            if attempt + 1 < n_attempts:
+                time.sleep(60)
+    if mesh is None:
+        _emit_summary()  # zero-value flagship line, honest artifact
+        return 2
     n_chips = len(jax.devices())
     on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
 
@@ -1164,4 +1184,6 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())
