@@ -80,6 +80,8 @@ PR_AVG_DEGREE = 8.0
 PR_ITERS_PER_CALL = 50
 V5E_HBM_BYTES_PER_SEC = 819e9
 WATCHDOG_SECONDS = int(os.environ.get("BENCH_WATCHDOG_SECONDS", 3600))
+INIT_RETRY_ATTEMPTS = 40   # backend-init retries (tunnel outages run
+INIT_RETRY_SECONDS = 60    # tens of minutes; watchdog covers hangs)
 # ^ 3600: a cold rig pays a one-time ~15 min generation of the 32 GB
 # streamed-dataset cache on top of the ~10 min bench proper; the
 # watchdog is a hang detector, not a time budget — it still emits the
@@ -1146,7 +1148,7 @@ def main(argv=None):
     # 40 x 60 s covers the observed outages while staying inside the
     # 3600 s watchdog (which handles the init-HANGS-forever mode).
     mesh = None
-    n_attempts = 40
+    n_attempts = INIT_RETRY_ATTEMPTS
     for attempt in range(n_attempts):
         try:
             mesh = get_mesh()
@@ -1156,7 +1158,7 @@ def main(argv=None):
                   f"(attempt {attempt + 1}/{n_attempts}): {e}",
                   file=sys.stderr)
             if attempt + 1 < n_attempts:
-                time.sleep(60)
+                time.sleep(INIT_RETRY_SECONDS)
     if mesh is None:
         _emit_summary()  # zero-value flagship line, honest artifact
         return 2

@@ -1,0 +1,59 @@
+"""Bench harness failure modes: a dead backend must still produce an
+honest artifact (all-metrics summary line + non-zero exit), never a
+silent empty run (r4 verdict: two rounds of headline numbers
+evaporated from the recorded tail)."""
+
+import json
+
+import pytest
+
+
+def test_backend_init_failure_emits_summary_and_fails(monkeypatch,
+                                                      capsys):
+    import bench
+    from tpu_distalg import parallel
+
+    calls = {"n": 0}
+
+    def dead_mesh(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: tunnel down (test)")
+
+    monkeypatch.setattr(parallel, "get_mesh", dead_mesh)
+    monkeypatch.setattr(bench, "INIT_RETRY_ATTEMPTS", 3)
+    monkeypatch.setattr(bench, "INIT_RETRY_SECONDS", 0)
+    monkeypatch.setattr(bench, "_SUMMARY", {})
+
+    rc = bench.main([])
+    assert rc == 2
+    assert calls["n"] == 3  # retried, then gave up
+    out = capsys.readouterr()
+    last = json.loads(out.out.strip().splitlines()[-1])
+    # the driver-schema flagship line with the all-metrics map, zeroed
+    assert last["metric"] == "ssgd_lr_steps_per_sec_per_chip"
+    assert last["value"] == 0.0
+    assert "all_metrics" in last
+    assert "backend init failed (attempt 3/3)" in out.err
+
+
+def test_summary_preserves_recorded_metrics():
+    """_emit_summary repeats every recorded metric in one line and
+    never clobbers an already-recorded flagship value."""
+    import bench
+
+    saved = dict(bench._SUMMARY)
+    try:
+        bench._SUMMARY.clear()
+        bench._emit({"metric": "ssgd_lr_steps_per_sec_per_chip",
+                     "value": 123.0, "unit": "steps/s/chip",
+                     "vs_baseline": 4.0})
+        bench._emit({"metric": "x", "value": 1.5, "unit": "u",
+                     "vs_baseline": None})
+        bench._SUMMARY.setdefault(
+            "ssgd_lr_steps_per_sec_per_chip",
+            {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": 0.0})
+        assert bench._SUMMARY[
+            "ssgd_lr_steps_per_sec_per_chip"]["value"] == 123.0
+    finally:
+        bench._SUMMARY.clear()
+        bench._SUMMARY.update(saved)
