@@ -150,6 +150,27 @@ def test_run_auto_prefers_spmv_and_matches_xla(mesh8):
                                rtol=1e-5, atol=1e-8)
 
 
+def test_spmv_rg_escalation_plans_sparse_graph(mesh8):
+    """A graph whose within-group dst span overflows at rg=128 (the
+    span grows as R²/(rg·E)) escalates to a taller gather window
+    instead of giving up — the 10M-vertex regime in miniature. Plan
+    invariants are checked; the rg=512 kernel's numerics are verified
+    on hardware (tests_tpu / the recorded 10M run)."""
+    v, e = 1_000_000, 1_000_000
+    edges = _random_graph(v, e, seed=7)
+    el = gops.prepare_edges(edges, v)
+    # rg=128 must fail on this sparsity...
+    assert pagerank.prepare_device_spmv(el, mesh8, rg=128) is None
+    # ...and the escalating default must land a valid taller plan
+    spmv = pagerank.prepare_device_spmv(el, mesh8)
+    assert spmv is not None
+    assert spmv.rg > 128
+    assert spmv.ws <= ppr.SPMV_WS_CAP
+    # window-relative indices must honor the planned windows
+    assert int(np.asarray(spmv.src_row).max()) < spmv.rg
+    assert int(np.asarray(spmv.dst_row).max()) < spmv.ws
+
+
 def test_spmv_without_plan_raises(mesh8):
     cfg = pagerank.PageRankConfig(mode="standard", scatter="spmv")
     with pytest.raises(ValueError, match="spmv"):
