@@ -50,6 +50,18 @@ The LAST stdout line repeats every metric in one compact
 ``all_metrics`` map (``_emit_summary``) so a tail-capturing driver
 always records the flagship numbers.
 
+Observability (round 6): backend init runs under
+``telemetry.supervisor`` (per-attempt deadline + retries — the r5 bench
+died to a 26-minute SILENT init hang), each bench phase is a telemetry
+span, and a ``telemetry.heartbeat`` watchdog emits the summary and
+exits 2 when no phase marks progress for ``WATCHDOG_SECONDS`` — with
+the stuck phase named in the event log. A second absolute timer
+(``HARD_DEADLINE_SECONDS``) prints the summary-so-far WITHOUT exiting,
+so even a slow-but-alive run that outlives the external driver's
+window leaves a parseable artifact. ``--telemetry-dir DIR`` (or
+``$TDA_TELEMETRY_DIR``) records the JSONL log; ``tda report DIR``
+summarizes it.
+
 Convergence evidence (recorded every round): the breast-cancer task is
 trained to 1500 iterations with each fused kernel and the final test
 accuracy is emitted in the SSGD JSON line (reference golden 0.929825,
@@ -60,6 +72,10 @@ import json
 import os
 import threading
 import time
+
+from tpu_distalg.telemetry import events as tevents
+from tpu_distalg.telemetry import heartbeat as theartbeat
+from tpu_distalg.telemetry import supervisor as tsupervisor
 
 N_ROWS = 1 << 20
 N_FEATURES = 125
@@ -80,15 +96,27 @@ PR_AVG_DEGREE = 8.0
 PR_ITERS_PER_CALL = 50
 V5E_HBM_BYTES_PER_SEC = 819e9
 WATCHDOG_SECONDS = int(os.environ.get("BENCH_WATCHDOG_SECONDS", 3600))
-INIT_RETRY_ATTEMPTS = 40   # backend-init retries (tunnel outages run
-INIT_RETRY_SECONDS = 60    # tens of minutes; watchdog covers hangs)
+INIT_RETRY_ATTEMPTS = 40   # backend-init attempts (tunnel outages run
+INIT_RETRY_SECONDS = 60    # tens of minutes; per-attempt deadline
+INIT_TIMEOUT_SECONDS = float(os.environ.get(
+    "BENCH_INIT_TIMEOUT_SECONDS", 300))  # covers the init-HANGS mode
 # ^ 3600: a cold rig pays a one-time ~15 min generation of the 32 GB
 # streamed-dataset cache on top of the ~10 min bench proper; the
 # watchdog is a hang detector, not a time budget — it still emits the
-# all-metrics summary when it fires.
+# all-metrics summary when it fires. Since round 6 it is a PHASE-stall
+# detector (telemetry.heartbeat over the per-phase marks), so a wedged
+# device dies with the stuck phase named in the telemetry log instead
+# of an anonymous absolute timer.
 
 
 _SUMMARY = {}
+# ONE lock serializes _SUMMARY mutation AND the stdout prints: the
+# heartbeat's stall path emits the summary from its daemon thread while
+# the main thread may be mid-_emit — unlocked, the two prints could
+# splice the single tail line the driver parses, and the summary's dict
+# comprehension could see a concurrent insert (RuntimeError). RLock:
+# _emit_summary emits through _emit while already holding it.
+_EMIT_LOCK = threading.RLock()
 
 
 def _emit(obj):
@@ -96,11 +124,14 @@ def _emit(obj):
     The driver keeps only the TAIL of stdout (r4 verdict: two rounds of
     flagship numbers evaporated because SSGD prints first), so
     :func:`_emit_summary` re-prints every recorded metric in one compact
-    final line."""
-    _SUMMARY[obj["metric"]] = {
-        "value": obj["value"], "unit": obj["unit"],
-        "vs_baseline": obj.get("vs_baseline")}
-    print(json.dumps(obj), flush=True)
+    final line. Each line is also mirrored into the telemetry log as a
+    ``metric`` event (``--telemetry-dir``)."""
+    with _EMIT_LOCK:
+        _SUMMARY[obj["metric"]] = {
+            "value": obj["value"], "unit": obj["unit"],
+            "vs_baseline": obj.get("vs_baseline")}
+        print(json.dumps(obj), flush=True)
+    tevents.emit("metric", **obj)
 
 
 def _emit_summary():
@@ -108,19 +139,21 @@ def _emit_summary():
     an ``all_metrics`` map of every line printed this run — the tail
     alone now reproduces every headline number."""
     flag = "ssgd_lr_steps_per_sec_per_chip"
-    head = _SUMMARY.get(
-        flag, {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": None})
-    _emit({
-        "metric": flag,
-        "value": head["value"],
-        "unit": head["unit"],
-        "vs_baseline": head["vs_baseline"],
-        "all_metrics": {k: v["value"] for k, v in _SUMMARY.items()},
-        "all_units": {k: v["unit"] for k, v in _SUMMARY.items()},
-        "all_vs_baseline": {k: v["vs_baseline"]
-                            for k, v in _SUMMARY.items()
-                            if v["vs_baseline"] is not None},
-    })
+    with _EMIT_LOCK:
+        head = _SUMMARY.get(
+            flag,
+            {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": None})
+        _emit({
+            "metric": flag,
+            "value": head["value"],
+            "unit": head["unit"],
+            "vs_baseline": head["vs_baseline"],
+            "all_metrics": {k: v["value"] for k, v in _SUMMARY.items()},
+            "all_units": {k: v["unit"] for k, v in _SUMMARY.items()},
+            "all_vs_baseline": {k: v["vs_baseline"]
+                                for k, v in _SUMMARY.items()
+                                if v["vs_baseline"] is not None},
+        })
 
 
 def _floor_denominator(measured, scan_rate_total):
@@ -176,17 +209,48 @@ def _scale_spread(spread, factor, ndigits=1):
     return out
 
 
-def _watchdog():
-    """If the device wedges (e.g. a dead TPU tunnel), emit the summary
-    of everything recorded SO FAR — flagship zeroed only if it never
-    ran — instead of hanging the harness forever. os._exit skips
-    main()'s finally, so the summary must be printed here."""
-    time.sleep(WATCHDOG_SECONDS)
-    _SUMMARY.setdefault(
-        "ssgd_lr_steps_per_sec_per_chip",
-        {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": 0.0})
+HARD_DEADLINE_SECONDS = int(os.environ.get(
+    "BENCH_HARD_DEADLINE_SECONDS", 3 * WATCHDOG_SECONDS))
+
+
+def _hard_deadline():
+    """Belt-and-braces artifact guarantee: a slow-but-ALIVE run keeps
+    marking progress and never trips the phase-stall watchdog, so if it
+    outlives the external driver's window the SIGKILL would leave no
+    summary (the r5 empty-artifact mode, progressing-slowly variant).
+    At the hard deadline the summary-so-far is printed WITHOUT exiting:
+    the run continues, the tail stays parseable from this moment on,
+    and a completed run's final summary still prints last."""
+    time.sleep(HARD_DEADLINE_SECONDS)
+    tevents.emit("hard_deadline", seconds=HARD_DEADLINE_SECONDS)
     _emit_summary()
+
+
+def _watchdog_fire(phase, age):
+    """Stall action for the telemetry heartbeat: if no bench phase
+    marks progress for WATCHDOG_SECONDS (a wedged device, a dead TPU
+    tunnel), emit the summary of everything recorded SO FAR — flagship
+    zeroed only if it never ran — instead of hanging the harness
+    forever. The heartbeat has already written the ``stall`` event
+    naming the stuck phase. os._exit skips main()'s finally, so the
+    summary must be printed here."""
+    with _EMIT_LOCK:
+        _SUMMARY.setdefault(
+            "ssgd_lr_steps_per_sec_per_chip",
+            {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": 0.0})
+        _emit_summary()
+    sink = tevents.get_sink()
+    if sink is not None:
+        sink.close()  # os._exit skips atexit: flush counters + run_end
     os._exit(2)
+
+
+def _phase(name, fn, *args):
+    """Run one bench phase inside a telemetry span: timed, stall-marked
+    (the heartbeat names this phase if the device wedges inside it),
+    and recorded in the event log for ``tda report``."""
+    with tevents.span(f"bench:{name}"):
+        return fn(*args)
 
 
 def _bench_ssgd(mesh, on_tpu, n_chips):
@@ -723,8 +787,9 @@ def _bench_ssgd_stream(mesh, n_chips):
         "dataset_bytes": dataset_bytes,
         "hbm_ratio": round(dataset_bytes / 16e9, 2),
         "data_path": "disk-memmap host dataset; sampled blocks "
-                     "host-gathered + async device_put, "
-                     "double-buffered (models/ssgd_stream.py)",
+                     "host-gathered on a one-deep prefetch thread + "
+                     "async device_put, double-buffered "
+                     "(models/ssgd_stream.py)",
         "minibatch_rows_per_step": trainer.h2d_bytes_per_step
         // (meta["d_total"] * 2),
         "h2d_bytes_per_step": trainer.h2d_bytes_per_step,
@@ -1134,34 +1199,50 @@ def main(argv=None):
     parser.add_argument("--profile", type=str, default=None, metavar="DIR",
                         help="capture a jax.profiler device trace of the "
                              "benchmarked runs into DIR")
+    parser.add_argument("--telemetry-dir", type=str, default=None,
+                        metavar="DIR",
+                        help="write structured JSONL runtime events "
+                             "(phases, heartbeats, stalls, backend-init "
+                             "attempts, every metric) into DIR; "
+                             "$TDA_TELEMETRY_DIR is the default; "
+                             "summarize with 'tda report DIR'")
     args = parser.parse_args(argv)
 
-    threading.Thread(target=_watchdog, daemon=True).start()
-    import sys
+    tevents.configure(args.telemetry_dir)
+    # phase-stall watchdog: replaces the absolute-timer _watchdog thread
+    # (and fixes its summary/print race by construction — one lock)
+    hb = theartbeat.Heartbeat(
+        interval=min(60.0, max(0.25, WATCHDOG_SECONDS / 4)),
+        stall_after=WATCHDOG_SECONDS, on_stall=_watchdog_fire)
+    hb.start()
+    threading.Thread(target=_hard_deadline, daemon=True,
+                     name="bench-hard-deadline").start()
+    try:
+        return _run(args)
+    finally:
+        hb.stop()
 
-    import jax
 
+def _run(args):
     from tpu_distalg.parallel import get_mesh
 
     # a tunneled TPU backend can be transiently UNAVAILABLE (observed:
-    # ~tens of minutes); retry init instead of dying with no artifact.
-    # 40 x 60 s covers the observed outages while staying inside the
-    # 3600 s watchdog (which handles the init-HANGS-forever mode).
-    mesh = None
-    n_attempts = INIT_RETRY_ATTEMPTS
-    for attempt in range(n_attempts):
-        try:
-            mesh = get_mesh()
-            break
-        except Exception as e:  # noqa: BLE001 — backend init only
-            print(f"[bench] backend init failed "
-                  f"(attempt {attempt + 1}/{n_attempts}): {e}",
-                  file=sys.stderr)
-            if attempt + 1 < n_attempts:
-                time.sleep(INIT_RETRY_SECONDS)
-    if mesh is None:
+    # ~tens of minutes) or HANG outright (observed: ~26 min, round 5);
+    # the supervisor runs each attempt under a deadline, retries with
+    # the fixed 60 s schedule (cap == base), records every attempt as
+    # telemetry events, and raises instead of dying with no artifact.
+    try:
+        mesh = tsupervisor.init_backend(
+            timeout=INIT_TIMEOUT_SECONDS,
+            retries=INIT_RETRY_ATTEMPTS - 1,
+            backoff=INIT_RETRY_SECONDS,
+            backoff_cap=INIT_RETRY_SECONDS,
+            init_fn=get_mesh)
+    except tsupervisor.BackendUnavailableError:
         _emit_summary()  # zero-value flagship line, honest artifact
         return 2
+    import jax
+
     n_chips = len(jax.devices())
     on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
 
@@ -1169,17 +1250,22 @@ def main(argv=None):
 
     try:
         with profiling.maybe_trace(args.profile):
-            ssgd_per_chip = _bench_ssgd(mesh, on_tpu, n_chips)
+            ssgd_per_chip = _phase("ssgd", _bench_ssgd, mesh, on_tpu,
+                                   n_chips)
             if on_tpu:
-                _bench_ssgd_scale(mesh, n_chips)
-                _bench_ssgd_virtual(mesh, n_chips)
-                _bench_ssgd_stream(mesh, n_chips)
-                _bench_local_sgd(mesh, n_chips, ssgd_per_chip)
-                _bench_kmeans_scale(mesh, n_chips)
-            _bench_pagerank(mesh, n_chips)
+                _phase("ssgd_100m", _bench_ssgd_scale, mesh, n_chips)
+                _phase("ssgd_1b_virtual", _bench_ssgd_virtual, mesh,
+                       n_chips)
+                _phase("ssgd_32gb_stream", _bench_ssgd_stream, mesh,
+                       n_chips)
+                _phase("local_sgd", _bench_local_sgd, mesh, n_chips,
+                       ssgd_per_chip)
+                _phase("kmeans_10m", _bench_kmeans_scale, mesh, n_chips)
+            _phase("pagerank", _bench_pagerank, mesh, n_chips)
             if on_tpu:
-                _bench_als(mesh, n_chips)
-                _bench_ring_attention(mesh, n_chips)
+                _phase("als", _bench_als, mesh, n_chips)
+                _phase("ring_attention", _bench_ring_attention, mesh,
+                       n_chips)
     finally:
         # even a partial run's metrics survive in the tail
         _emit_summary()

@@ -13,10 +13,12 @@ Layer map (SURVEY.md §7):
     ops/       — jittable numeric kernels (replaces the per-script NumPy lambdas)
     models/    — workload entry points (replaces the reference's __main__ scripts)
     utils/     — PRNG, datasets, metrics, plotting, checkpointing
+    telemetry/ — structured JSONL runtime events, heartbeat/stall detection,
+                 supervised backend init, `tda report` log summarization
 """
 
-from tpu_distalg import ops, parallel, utils
+from tpu_distalg import ops, parallel, telemetry, utils
 
 __version__ = "0.1.0"
 
-__all__ = ["ops", "parallel", "utils", "__version__"]
+__all__ = ["ops", "parallel", "telemetry", "utils", "__version__"]

@@ -57,6 +57,17 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     _add_ckpt(p, 500)
 
 
+def _add_telemetry(p):
+    """Telemetry flag — on EVERY subcommand: structured JSONL runtime
+    events (marks, spans, heartbeats, stalls, restarts) for the run,
+    summarized by ``tda report DIR`` (tpu_distalg/telemetry/)."""
+    p.add_argument("--telemetry-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="write structured JSONL runtime events here "
+                        "($TDA_TELEMETRY_DIR is the default when "
+                        "unset); summarize with 'tda report DIR'")
+
+
 def _add_ckpt(p, every_default):
     """Checkpoint/watchdog flags — on EVERY subcommand, optimizer or
     not: the task-retry capability Spark gives every reference script
@@ -70,6 +81,7 @@ def _add_ckpt(p, every_default):
                         "NaN-guard trip; with --checkpoint-dir each "
                         "restart resumes from the latest checkpoint "
                         "(bitwise-identical to an uninterrupted run)")
+    _add_telemetry(p)
 
 
 def _report_optimizer(name, res, args, t):
@@ -217,8 +229,34 @@ def main(argv=None):
     p.add_argument("--max-restarts", type=int, default=0,
                    help="retry the (stateless, deterministic) estimate "
                         "up to N times on a device crash")
+    _add_telemetry(p)
+
+    p = sub.add_parser("report",
+                       help="summarize a telemetry event log: phase "
+                            "durations, stalls, backend-init attempts, "
+                            "restarts, last heartbeat, metrics")
+    p.add_argument("dir", help="telemetry directory (of events-*.jsonl) "
+                               "or one event file")
+    p.add_argument("--json", action="store_true",
+                   help="print the full summary as JSON (for CI)")
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "report":
+        # pure log analysis — no backend, no mesh, no jax import
+        from tpu_distalg.telemetry import report as treport
+
+        try:
+            return treport.report_main(args.dir, as_json=args.json)
+        except FileNotFoundError as e:
+            # a typo'd path is the expected human error here — message,
+            # not traceback
+            print(f"tda report: {e}", file=sys.stderr)
+            return 2
+
+    from tpu_distalg import telemetry
+
+    telemetry.configure(getattr(args, "telemetry_dir", None))
 
     if args.emulate:
         from tpu_distalg.parallel.mesh import emulate_devices
@@ -248,8 +286,19 @@ def main(argv=None):
 
     from tpu_distalg.utils import profiling
 
-    with profiling.maybe_trace(args.profile):
-        return _dispatch(args, jax)
+    # stall threshold well above the legitimately silent multi-minute
+    # phases a healthy run contains (first XLA/Mosaic compiles, the
+    # spmv plan's host sorts) — marks land at phase boundaries, not
+    # inside them, and a stall line on a healthy run muddies the one
+    # signal built to diagnose real hangs
+    hb = telemetry.start_heartbeat(stall_after=600.0)
+    try:
+        with profiling.maybe_trace(args.profile):
+            with telemetry.span(f"cli:{args.cmd}"):
+                return _dispatch(args, jax)
+    finally:
+        if hb is not None:
+            hb.stop()
 
 
 def _dispatch(args, jax):

@@ -454,6 +454,11 @@ def train(
     runs are bitwise-identical because round PRNG keys use absolute
     round ids.
     """
+    from tpu_distalg.telemetry import events as tevents
+
+    # progress mark: the heartbeat names this phase if a round wedges
+    # (checkpointed runs also mark per segment inside run_segmented)
+    tevents.mark(f"local_sgd:{config.global_update}", emit_event=False)
     if config.sampler in ("fused_gather", "fused_train"):
         return _train_fused(
             X_train, y_train, X_test, y_test, mesh, config,

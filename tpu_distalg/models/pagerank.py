@@ -155,17 +155,27 @@ def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
     each escalation re-sorts, so the 512 attempt on an 80M-edge graph
     spends ~2-3 minutes of host prep. VMEM bounds the table:
     (r8 + ws + rg) · 512 B must stay under the ~100 MB budget, which
-    holds to ~11M vertices."""
+    holds to ~12M vertices — ``plan_spmv`` now enforces that budget
+    itself (``spmv_resident_bytes``) BEFORE paying the sorts, so
+    oversized graphs degrade here instead of failing the Mosaic
+    compile minutes later. Each plan attempt runs in a telemetry span
+    (``pagerank:plan_spmv:rgN``) — the sorts are exactly the kind of
+    multi-minute host phase a stall report must be able to name."""
     from tpu_distalg.ops import pallas_pagerank as ppr
+    from tpu_distalg.telemetry import events as tevents
 
     inv_deg = _inv_out_degree(el)
     n_shards = mesh.shape[DATA_AXIS]
     plan = None
     for r in ((rg,) if rg is not None else (ppr.SPMV_RG, 256, 512)):
-        plan = ppr.plan_spmv(el.src, el.dst, inv_deg[el.src],
-                             el.n_vertices, n_shards=n_shards, rg=r)
+        with tevents.span(f"pagerank:plan_spmv:rg{r}",
+                          n_edges=int(el.n_edges),
+                          n_vertices=int(el.n_vertices)):
+            plan = ppr.plan_spmv(el.src, el.dst, inv_deg[el.src],
+                                 el.n_vertices, n_shards=n_shards, rg=r)
         if plan is not None:
             break
+        tevents.counter("spmv_plan_rejections")
     if plan is None:
         return None
     s1 = data_sharding(mesh, 1)

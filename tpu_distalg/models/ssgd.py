@@ -28,6 +28,7 @@ from tpu_distalg.parallel import (
     parallelize,
     tree_allreduce_sum,
 )
+from tpu_distalg.telemetry import events as tevents
 from tpu_distalg.utils import metrics, prng
 
 
@@ -735,6 +736,10 @@ def train(
     from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
     from jax.sharding import NamedSharding
 
+    # progress mark: the telemetry heartbeat names this phase if the
+    # compiled schedule wedges (checkpointed runs also mark per segment
+    # inside run_segmented)
+    tevents.mark(f"ssgd:{config.sampler}", emit_event=False)
     if config.sampler in ("fused", "fused_gather", "fused_train"):
         if config.feature_sharded:
             if config.sampler != "fused_gather":
@@ -859,8 +864,9 @@ def prepare_fused_synthetic(
     import numpy as np
 
     from jax import lax
-    from jax import shard_map
     from jax.sharding import NamedSharding
+
+    from tpu_distalg.parallel.compat import shard_map
 
     from tpu_distalg.ops import pallas_kernels
     from tpu_distalg.parallel import DATA_AXIS

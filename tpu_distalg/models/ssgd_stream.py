@@ -24,9 +24,14 @@ TPU-native shape of the answer:
     with one fancy-index memcpy, and ``jax.device_put`` stages them
     ASYNCHRONOUSLY onto the mesh (sharded over the data axis);
   * the staging of step t+1 is enqueued BEFORE step t's gradient is
-    dispatched (double buffering): H2D DMA, host gather, and device
-    compute overlap, so the steady-state rate is
-    min(H2D bandwidth, device rate) — not their serial sum;
+    dispatched (double buffering), and the HOST GATHER runs on a
+    background prefetch thread (``_gather`` producer → maxsize-1
+    queue → ``_put`` on the dispatch thread; at most two gathered
+    batches resident beyond the one in compute): gather(t+2),
+    H2D(t+1) and compute(t) genuinely overlap, so the steady-state
+    rate is max(gather, H2D, compute) — not their serial sum (before
+    round 6 the gather ran synchronously on the dispatch thread, which
+    for a disk-memmap >RAM dataset made it gather + min(H2D, compute));
   * the device step feeds the staged blocks to the SAME kernel the
     resident path runs (``fused_grad_sum_gathered`` with the identity
     block index), so the weight trajectory is bitwise-identical to
@@ -41,6 +46,8 @@ straight runs, like every other sampler.
 from __future__ import annotations
 
 import functools
+import queue
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -213,46 +220,110 @@ class StreamTrainer:
             n_shards * n_sampled * self.bp * self.X2.shape[1]
             * self.X2.dtype.itemsize)
 
-    def _stage(self, ids_step: np.ndarray):
-        """One host gather + async H2D: (S, ns·bp, pd) onto the mesh.
-
-        The returned array is TOUCHED with a tiny async reduction so
-        the transfer actually starts now: on tunneled/lazy backends
-        ``device_put`` (and even ``block_until_ready`` on its result)
-        can defer the copy until first use, which would serialize the
-        H2D behind the next step instead of overlapping it."""
+    def _gather(self, ids_step: np.ndarray) -> np.ndarray:
+        """The HOST side of staging one step: the fancy-index gather of
+        the sampled blocks out of the (possibly disk-memmap) matrix —
+        for a >RAM dataset this is the dominant per-step cost, which is
+        why :meth:`run` executes it on the prefetch thread. Pure numpy:
+        safe off the JAX dispatch thread."""
         rows = (ids_step[:, :, None] * self.bp
                 + np.arange(self.bp)[None, None, :]).reshape(
                     self.n_shards, -1)
         rows = rows + self._row_offsets
-        staged = jax.device_put(self.X2[rows], self.shard_spec)
+        return self.X2[rows]
+
+    def _put(self, gathered: np.ndarray):
+        """The DEVICE side: async H2D of one gathered (S, ns·bp, pd)
+        batch onto the mesh, TOUCHED with a tiny async reduction so the
+        transfer actually starts now — on tunneled/lazy backends
+        ``device_put`` (and even ``block_until_ready`` on its result)
+        can defer the copy until first use, which would serialize the
+        H2D behind the next step instead of overlapping it."""
+        staged = jax.device_put(gathered, self.shard_spec)
         self._touch(staged)  # async; result dropped
         return staged
+
+    def _stage(self, ids_step: np.ndarray):
+        """Serial gather+put of one step's batch — the shape bench.py's
+        H2D-roofline probe measures on purpose (no prefetch)."""
+        return self._put(self._gather(ids_step))
 
     def run(self, w, t0: int, n_steps: int, acc0=0.0):
         """``n_steps`` double-buffered steps from absolute step ``t0``;
         returns ``(w, accs)`` with the scan path's eval_every/last-acc
         semantics (``acc0`` carries the last computed accuracy across
         segment boundaries). Device values only are carried — no host
-        sync until the final fetch."""
+        sync until the final fetch.
+
+        The host gather runs on a background prefetch thread behind a
+        maxsize-1 queue: gather(t+2) ∥ H2D(t+1) ∥ compute(t). Host
+        residency is bounded at up to two gathered batches beyond the
+        one in compute — one staged-ready in the queue plus the one
+        being gathered (the queue bounds the QUEUE depth at one; the
+        producer's in-flight gather is the second). Block order and
+        content are identical to the serial path, so the weight
+        trajectory stays bitwise-equal to the resident 'fused_gather'
+        sampler. A producer-side
+        exception is forwarded through the queue and re-raised here;
+        on any exit the producer is stopped and joined."""
+        from tpu_distalg.telemetry import events as tevents
+
         cfg = self.config
         ts = np.arange(t0, t0 + n_steps)
         ids = self._draw(ts)
         accs = []
         last_acc = jnp.float32(acc0)
-        staged = self._stage(ids[0]) if n_steps else None
-        for i in range(n_steps):
-            nxt = self._stage(ids[i + 1]) if i + 1 < n_steps else None
-            w = self.step_fn(staged, w)
-            if self._serialize:
-                jax.block_until_ready(w)
-            if self.eval_fn is not None:
-                if ts[i] % cfg.eval_every == 0:
-                    last_acc = self.eval_fn(*self._eval_args, w)
-                accs.append(last_acc)
-            else:
-                accs.append(last_acc)
-            staged = nxt
+        halt = threading.Event()
+        q: queue.Queue = queue.Queue(maxsize=1)
+
+        def offer(item) -> bool:
+            while not halt.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for i in range(n_steps):
+                    if not offer(self._gather(ids[i])):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                offer(e)
+
+        def next_batch():
+            item = q.get()
+            if isinstance(item, BaseException):
+                raise item
+            return item
+
+        th = None
+        if n_steps:
+            th = threading.Thread(target=producer, daemon=True,
+                                  name="tda-stream-prefetch")
+            th.start()
+        try:
+            staged = self._put(next_batch()) if n_steps else None
+            for i in range(n_steps):
+                tevents.mark("ssgd_stream:step", emit_event=False)
+                nxt = (self._put(next_batch()) if i + 1 < n_steps
+                       else None)
+                w = self.step_fn(staged, w)
+                if self._serialize:
+                    jax.block_until_ready(w)
+                if self.eval_fn is not None:
+                    if ts[i] % cfg.eval_every == 0:
+                        last_acc = self.eval_fn(*self._eval_args, w)
+                    accs.append(last_acc)
+                else:
+                    accs.append(last_acc)
+                staged = nxt
+        finally:
+            halt.set()
+            if th is not None:
+                th.join(timeout=10.0)
         return w, jnp.stack(accs) if accs else jnp.zeros((0,))
 
 
@@ -263,6 +334,9 @@ def train(X2_host, meta: dict, mesh: Mesh, config: SSGDConfig,
     """End-to-end streamed run (optionally checkpointed/segmented —
     bitwise-identical to a straight run, sampling is keyed on absolute
     step ids)."""
+    from tpu_distalg.telemetry import events as tevents
+
+    tevents.mark("ssgd_stream:train", emit_event=False)
     trainer = StreamTrainer(X2_host, meta, mesh, config, X_test, y_test)
     if w0 is None:
         d = (X_test.shape[1] if X_test is not None

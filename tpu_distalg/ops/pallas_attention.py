@@ -47,6 +47,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_distalg.ops.pallas_compat import \
+    COMPILER_PARAMS as _COMPILER_PARAMS
+
 _NEG = -1e30
 
 # Backward tile edge, measured-best at 32k tokens (71.9 TFLOP/s
@@ -218,7 +221,7 @@ def flash_attention_block(q, k, v, o, m, l, q_off, k_off, *,
             jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
             jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -416,7 +419,7 @@ def flash_attention_backward_block(q, k, v, do, lse, delta,
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -454,7 +457,7 @@ def flash_attention_backward_block(q, k, v, do, lse, delta,
             jax.ShapeDtypeStruct((h_kv, s_kv, d), jnp.float32),
             jax.ShapeDtypeStruct((h_kv, s_kv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

@@ -1,0 +1,13 @@
+"""jax-version compatibility pinpoints for the Pallas kernel modules.
+
+Kept separate from ``parallel/compat.py`` so importing the runtime core
+never pays the ``jax.experimental.pallas`` import.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+# pre-0.6 jax spells CompilerParams TPUCompilerParams — same fields
+COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams

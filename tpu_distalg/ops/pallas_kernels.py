@@ -54,6 +54,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_distalg.ops.pallas_compat import \
+    COMPILER_PARAMS as _COMPILER_PARAMS
+
 # Weyl-sequence constant (2^32/φ, as int32) for mixing the block index
 # into the 2-word hardware PRNG seed.
 _WEYL = -1640531527
@@ -130,7 +133,7 @@ def fused_grad_sum(X, y, mask, w, *, block_rows: int = 2048,
             pltpu.VMEM((d_t, 1), jnp.float32),
             pltpu.SMEM((1, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -340,7 +343,7 @@ def fused_grad_sum_gathered(X2, w_aug, block_idx, *, pack: int,
             jax.ShapeDtypeStruct((P, P * D), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -560,7 +563,7 @@ def fused_train_gathered(X2, w_tile0, block_idx, *, pack: int,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((P * D, 1), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -626,7 +629,7 @@ def fused_forward_gathered(X2, w_aug, block_idx, *, pack: int,
             out_specs=pl.BlockSpec((bp, 3 * P), lambda i, s: (i, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((n_s * bp, 3 * P), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -698,7 +701,7 @@ def fused_backward_gathered(X2, resid, block_idx, *, pack: int,
             scratch_shapes=[pltpu.VMEM((P, P * D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((P, P * D), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -784,7 +787,7 @@ def fused_grad_sum_packed(X2, w_aug, t, shard, *, pack: int, d_total: int,
             jax.ShapeDtypeStruct((P, P * D), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

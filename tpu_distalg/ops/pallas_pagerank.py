@@ -94,6 +94,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_distalg.ops.pallas_compat import \
+    COMPILER_PARAMS as _COMPILER_PARAMS
+
 LANES = 128
 DEF_CHUNK = 1024  # edges per in-kernel chunk (one matmul each)
 DEF_BLK = 32      # chunks per grid step (keeps per-shard padding small)
@@ -113,6 +116,27 @@ MAX_W = 4         # widest row window: 8*W rows; beyond -> fall back
 SPMV_RG = 128      # gather window rows (vertices / window = rg*128)
 SPMV_WS_CAP = 192  # max scatter window rows before falling back
 SPMV_BLK = 8       # chunks per grid step
+# plan-time VMEM budget: spmv_table compiles with vmem_limit_bytes =
+# 128 MB, but Mosaic also needs scratch for the per-chunk temporaries
+# (the (ws,128) upd accumulator, (128,128) one-hots, select masks), so
+# plans whose RESIDENT footprint passes ~100 MB fail at compile time —
+# after the multi-minute host sorts. plan_spmv rejects them up front
+# (spmv_resident_bytes), so scatter='auto' degrades to the hybrid/XLA
+# sweep instead. ~100 MB ≈ 8 bytes/vertex → the path self-caps at
+# ~12-13M vertices, matching the module docstring's measured bound.
+SPMV_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def spmv_resident_bytes(n_vertices: int, rg: int, ws: int,
+                        blk: int = SPMV_BLK) -> int:
+    """Kernel-resident VMEM bytes of an SpMV plan geometry: the ranks
+    table (r8+rg, 128) f32 + the output table (r8+ws, 128) f32 + the 5
+    per-grid-step edge-block operands (blk·8, 128) i32/f32, double-
+    buffered by the grid pipeline."""
+    r8 = ((n_vertices + LANES - 1) // LANES + 7) // 8 * 8
+    tables = (r8 + rg + r8 + ws) * LANES * 4
+    edge_blocks = 2 * 5 * blk * 8 * LANES * 4
+    return tables + edge_blocks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -260,8 +284,11 @@ def plan_spmv(src: np.ndarray, dst: np.ndarray, w_e: np.ndarray,
               blk: int = SPMV_BLK, rg: int = SPMV_RG) -> SpMVPlan | None:
     """Two-key sort + per-group chunk padding + window metadata, or
     ``None`` when a group's within-chunk dst span exceeds
-    ``SPMV_WS_CAP`` rows (very sparse/skewed graphs — callers fall back
-    to the hybrid or XLA path; correctness never depends on the plan).
+    ``SPMV_WS_CAP`` rows (very sparse/skewed graphs) or the kernel's
+    resident VMEM footprint would exceed ``SPMV_VMEM_BUDGET`` (vertex
+    tables at V≳12M — checked BEFORE the multi-minute host sorts) —
+    callers fall back to the hybrid or XLA path; correctness never
+    depends on the plan.
 
     Padding edges replicate a chunk's last (src, dst) with zero weight
     — inert in both the gather (reads a real window row) and the
@@ -271,6 +298,13 @@ def plan_spmv(src: np.ndarray, dst: np.ndarray, w_e: np.ndarray,
     w_e = np.asarray(w_e, np.float32)
     e = len(src)
     if e == 0:
+        return None
+    # VMEM guard BEFORE the expensive host work: when even the smallest
+    # possible scatter window (ws=8) cannot fit the budget, the Mosaic
+    # compile is guaranteed to fail AFTER the multi-minute sorts — bail
+    # now so scatter='auto' degrades to the hybrid/XLA sweep instead
+    # (ADVICE r5: the tables alone blow the budget at V≳12M).
+    if spmv_resident_bytes(n_vertices, rg, 8, blk) > SPMV_VMEM_BUDGET:
         return None
     # groups = EVEN partitions of the table rows (a fixed rg-row stride
     # would leave a skinny remainder group whose few edges span the
@@ -336,6 +370,8 @@ def plan_spmv(src: np.ndarray, dst: np.ndarray, w_e: np.ndarray,
     ws = (span + 7) // 8 * 8
     if ws > SPMV_WS_CAP:
         return None
+    if spmv_resident_bytes(n_vertices, rg, ws, blk) > SPMV_VMEM_BUDGET:
+        return None  # actual ws confirmed the footprint overflow
     r8 = ((n_vertices + LANES - 1) // LANES + 7) // 8 * 8
     shape8 = (n_ch * 8, LANES)
     return SpMVPlan(
@@ -432,7 +468,7 @@ def spmv_table(gbase, sbase, ranks_padded, src_lane, src_row, dst_row,
                                    lambda i, s1, s2: (0, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((r8 + ws, LANES), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=128 * 1024 * 1024),
         interpret=interpret,
@@ -467,7 +503,7 @@ def scatter_table(base, contribs, row, lane, *, w: int, r8: int,
                                    lambda i, s: (0, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((r8 + 8 * w, LANES), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,

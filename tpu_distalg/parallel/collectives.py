@@ -22,6 +22,8 @@ import jax
 from jax import lax
 
 from tpu_distalg.parallel.mesh import DATA_AXIS
+from tpu_distalg.parallel.compat import axis_size as _axis_size
+
 
 
 def tree_allreduce_sum(tree, axis_name: str = DATA_AXIS):
@@ -46,7 +48,7 @@ def ring_shift(x: jax.Array, axis_name: str = DATA_AXIS, shift: int = 1):
     A ``ppermute`` over the mesh axis — the ICI-native neighbour exchange
     used by ring algorithms (ring all-reduce, ring attention).
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

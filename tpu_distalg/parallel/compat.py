@@ -1,0 +1,42 @@
+"""jax-version compatibility pinpoints (pre-0.6 spellings).
+
+The ONE home for runtime-layer shims, so dropping support for old jax
+is a single-file delete: every helper resolves the modern spelling
+first and only falls back when it is absent.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+try:
+    from jax import shard_map as _shard_map_modern
+except ImportError:  # pre-0.6: shard_map lives in jax.experimental
+    _shard_map_modern = None
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+
+def shard_map(fn, mesh, *, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the pre-0.6 fallback (where the
+    replication check is spelled ``check_rep`` — same semantics).
+    Defaults match jax's own (check on), so this is a drop-in
+    replacement; opt out explicitly where the check is unwanted
+    (``spmd.data_parallel`` does)."""
+    if _shard_map_modern is not None:
+        return _shard_map_modern(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _shard_map_legacy(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(axis_name):
+    """``lax.axis_size``, or the pre-0.6 idiom: psum of a literal folds
+    to a static int under shard_map/pmap."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

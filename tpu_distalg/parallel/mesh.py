@@ -59,8 +59,15 @@ def multihost_initialize(**kwargs) -> None:
     ``jax.distributed.initialize``, which it wraps). Idempotent: a no-op if
     the distributed client is already up.
     """
-    if jax.distributed.is_initialized():
-        return
+    if getattr(jax.distributed, "is_initialized", None) is not None:
+        if jax.distributed.is_initialized():
+            return
+    else:
+        # pre-0.6 jax: no is_initialized — probe the global client state
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return
     jax.distributed.initialize(**kwargs)
 
 

@@ -125,7 +125,8 @@ def build_sharded(
     ``row_multiple × n_shards``; padded rows carry mask 0.
     """
     from jax import lax
-    from jax import shard_map
+
+    from tpu_distalg.parallel.compat import shard_map
 
     n_shards = mesh.shape[DATA_AXIS]
     mult = n_shards * row_multiple

@@ -32,7 +32,9 @@ def data_parallel(fn, mesh: Mesh, *, in_specs, out_specs,
     operands — mirroring exactly which reference values travelled via
     ``parallelize`` vs ``broadcast``.
     """
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    from tpu_distalg.parallel.compat import shard_map
+
+    return shard_map(
+        fn, mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=check_vma,
     )

@@ -1,0 +1,210 @@
+"""Event-log summarization — ``tda report <dir>``.
+
+Turns a telemetry JSONL log into the 3-line diagnosis round 5 lacked:
+phase durations (from spans), stall/retry/restart counts, backend-init
+attempt history and resolution, last heartbeat age, and every recorded
+metric/gauge — for humans (default rendering) and CI (``--json``).
+Tolerates torn tail lines (a killed process loses at most the line it
+was writing) and multiple runs' files in one directory.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+
+def load_events(path: str) -> list[dict]:
+    """All events under ``path`` (a directory of ``events-*.jsonl`` or
+    one file), in file order; undecodable lines are skipped (the torn
+    tail of a killed run), counted in a synthetic leading
+    ``{"ev": "_torn_lines"}`` record when any were dropped."""
+    if os.path.isfile(path):
+        paths = [path]
+    else:
+        # oldest first BY MTIME (run ids are random hex, so a name sort
+        # is arbitrary): "last wins" fields — last_heartbeat, resolution,
+        # metrics — must come from the NEWEST run in a reused directory
+        paths = sorted(glob.glob(os.path.join(path, "events-*.jsonl")),
+                       key=lambda p: (os.path.getmtime(p), p))
+        if not paths:
+            raise FileNotFoundError(
+                f"no events-*.jsonl under {path!r} (and it is not a "
+                f"file) — was the run started with --telemetry-dir?")
+    out: list[dict] = []
+    torn = 0
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    if torn:
+        out.insert(0, {"ev": "_torn_lines", "count": torn})
+    return out
+
+
+def summarize(evts: list[dict]) -> dict:
+    """Aggregate an event list into one report dict (see keys below)."""
+    phases: dict[str, dict] = {}
+    open_spans: dict[str, int] = {}
+    stalls: list[dict] = []
+    init_attempts: list[dict] = []
+    metrics: dict[str, dict] = {}
+    gauges: dict[str, object] = {}
+    counters: dict[str, int] = {}
+    restarts = quarantines = checkpoints = marks = heartbeats = 0
+    last_heartbeat = None
+    resolution = None
+    runs: list[str] = []
+    t_wall = [e["t_wall"] for e in evts if "t_wall" in e]
+    for e in evts:
+        ev = e.get("ev")
+        run = e.get("run")
+        if run and run not in runs:
+            runs.append(run)
+        if ev == "span_start":
+            open_spans[e.get("name", "?")] = \
+                open_spans.get(e.get("name", "?"), 0) + 1
+        elif ev == "span_end":
+            name = e.get("name", "?")
+            open_spans[name] = open_spans.get(name, 1) - 1
+            p = phases.setdefault(
+                name, {"count": 0, "total_seconds": 0.0,
+                       "max_seconds": 0.0, "errors": 0})
+            s = float(e.get("seconds", 0.0))
+            p["count"] += 1
+            p["total_seconds"] = round(p["total_seconds"] + s, 6)
+            p["max_seconds"] = round(max(p["max_seconds"], s), 6)
+            if not e.get("ok", True):
+                p["errors"] += 1
+        elif ev == "mark":
+            marks += 1
+        elif ev == "heartbeat":
+            heartbeats += 1
+            last_heartbeat = {
+                "phase": e.get("phase"),
+                "seconds_since_mark": e.get("seconds_since_mark"),
+                "t_wall": e.get("t_wall"),
+            }
+        elif ev == "stall":
+            stalls.append({"phase": e.get("phase"),
+                           "seconds_since_mark":
+                               e.get("seconds_since_mark")})
+        elif ev == "backend_init":
+            init_attempts.append({"attempt": e.get("attempt"),
+                                  "outcome": e.get("outcome"),
+                                  "seconds": e.get("seconds")})
+            if e.get("outcome") == "ok":
+                resolution = "ok"
+        elif ev == "degraded":
+            resolution = "degraded"
+        elif ev == "backend_unavailable":
+            resolution = "backend_unavailable"
+        elif ev == "restart":
+            restarts += 1
+        elif ev == "quarantine":
+            quarantines += 1
+        elif ev == "checkpoint_saved":
+            checkpoints += 1
+        elif ev == "metric" and "metric" in e:
+            metrics[e["metric"]] = {
+                "value": e.get("value"), "unit": e.get("unit"),
+                "vs_baseline": e.get("vs_baseline")}
+        elif ev == "gauge" and "name" in e:
+            gauges[e["name"]] = e.get("value")
+        elif ev == "counters":
+            for k, v in (e.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + int(v)
+    return {
+        "runs": runs,
+        "n_events": len(evts),
+        "wall_seconds": (round(max(t_wall) - min(t_wall), 3)
+                         if t_wall else 0.0),
+        "phases": phases,
+        "unfinished_phases": sorted(
+            k for k, v in open_spans.items() if v > 0),
+        "marks": marks,
+        "heartbeats": heartbeats,
+        "last_heartbeat": last_heartbeat,
+        "stalls": stalls,
+        "backend_init": {"attempts": init_attempts,
+                         "resolution": resolution},
+        "restarts": restarts,
+        "quarantines": quarantines,
+        "checkpoints_saved": checkpoints,
+        "counters": counters,
+        "gauges": gauges,
+        "metrics": metrics,
+        "torn_lines": next((e["count"] for e in evts
+                            if e.get("ev") == "_torn_lines"), 0),
+    }
+
+
+def render(s: dict) -> str:
+    """Human rendering of :func:`summarize`'s dict."""
+    lines = [
+        f"runs: {len(s['runs'])} ({', '.join(s['runs']) or '-'})",
+        f"events: {s['n_events']}  wall: {s['wall_seconds']}s  "
+        f"marks: {s['marks']}  heartbeats: {s['heartbeats']}",
+    ]
+    if s["phases"]:
+        lines.append("phase durations:")
+        for name, p in sorted(s["phases"].items(),
+                              key=lambda kv: -kv[1]["total_seconds"]):
+            err = f"  errors: {p['errors']}" if p["errors"] else ""
+            lines.append(
+                f"  {name}: {p['total_seconds']}s total over "
+                f"{p['count']} span(s), max {p['max_seconds']}s{err}")
+    for name in s["unfinished_phases"]:
+        lines.append(f"  {name}: UNFINISHED (no span_end recorded)")
+    hb = s["last_heartbeat"]
+    lines.append(
+        "last heartbeat: "
+        + (f"phase={hb['phase']} seconds_since_mark="
+           f"{hb['seconds_since_mark']}" if hb else "none recorded"))
+    lines.append(
+        f"stalls: {len(s['stalls'])}"
+        + ("".join(f"\n  stalled in {st['phase']} "
+                   f"({st['seconds_since_mark']}s since last mark)"
+                   for st in s["stalls"]) if s["stalls"] else ""))
+    bi = s["backend_init"]
+    if bi["attempts"] or bi["resolution"]:
+        outcomes = ", ".join(
+            f"#{a['attempt']} {a['outcome']} ({a['seconds']}s)"
+            for a in bi["attempts"])
+        lines.append(f"backend init: {outcomes or '-'} -> "
+                     f"{bi['resolution'] or 'unresolved'}")
+    lines.append(f"restarts: {s['restarts']}  "
+                 f"quarantines: {s['quarantines']}  "
+                 f"checkpoints saved: {s['checkpoints_saved']}")
+    if s["counters"]:
+        lines.append("counters: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s["counters"].items())))
+    if s["gauges"]:
+        lines.append("gauges: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s["gauges"].items())))
+    if s["metrics"]:
+        lines.append("metrics:")
+        for name, m in s["metrics"].items():
+            vs = (f"  ({m['vs_baseline']}x baseline)"
+                  if m.get("vs_baseline") is not None else "")
+            lines.append(f"  {name}: {m['value']} {m['unit']}{vs}")
+    if s["torn_lines"]:
+        lines.append(f"torn lines skipped: {s['torn_lines']}")
+    return "\n".join(lines)
+
+
+def report_main(path: str, as_json: bool = False, out=print) -> int:
+    """The ``tda report <dir>`` entry point."""
+    summary = summarize(load_events(path))
+    out(json.dumps(summary, indent=2) if as_json else render(summary))
+    return 0

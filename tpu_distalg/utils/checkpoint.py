@@ -18,6 +18,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from tpu_distalg.telemetry import events as tevents
+
 _STEP_RE = re.compile(r"^step_(\d+)\.msgpack$")
 
 
@@ -168,6 +170,9 @@ def run_segmented(
         if stop_when is not None and stop_when(state):
             break
         seg = min(checkpoint_every, n_iterations - t)
+        # progress mark per segment: the telemetry heartbeat flags this
+        # phase if a segment wedges (device hang) instead of staying mute
+        tevents.mark(f"segment:{tag or 'train'}@{t}", emit_event=False)
         if seg not in seg_fns:
             seg_fns[seg] = make_seg_fn(seg)
         state, accs = run_seg(seg_fns[seg], state, t)
@@ -185,6 +190,8 @@ def run_segmented(
             step=t,
         )
         prune(checkpoint_dir, keep=keep)
+        tevents.emit("checkpoint_saved", step=t, tag=tag)
+        tevents.counter("checkpoints_saved")
     accs = (np.concatenate(accs_parts) if accs_parts
             else np.zeros((0,), np.float32))
     return state, accs, start
@@ -242,6 +249,8 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
                     f"({os_err}); manual cleanup required"
                 )
                 raise e from os_err
+            tevents.emit("quarantine", path=e.path)
+            tevents.counter("quarantines")
             (logger or print)(
                 f"[quarantine] corrupt checkpoint {e.path} -> .corrupt; "
                 f"resuming from the previous step (restart budget "
@@ -252,7 +261,13 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
         except Exception as e:  # noqa: BLE001 — anything restartable
             attempt += 1
             if attempt > max_restarts:
+                tevents.emit("restart_budget_exhausted",
+                             attempts=attempt - 1, of=max_restarts,
+                             error=f"{type(e).__name__}: {e}")
                 raise
+            tevents.emit("restart", attempt=attempt, of=max_restarts,
+                         error=f"{type(e).__name__}: {e}")
+            tevents.counter("restarts")
             (logger or print)(
                 f"[restart {attempt}/{max_restarts}] "
                 f"{type(e).__name__}: {e} — re-running (resumes from "
