@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Reconcile README performance claims against the newest bench artifact.
+
+VERDICT weak #2: README numbers can drift from what the recorded
+``BENCH_r*.json`` artifacts actually measured. This script extracts the
+README's headline performance numbers (a claims table of regexes — one
+per metric the bench emits), loads the newest artifact whose ``parsed``
+field carries metrics (``all_metrics`` map or a single metric line),
+and FAILS (exit 1) when a claim's counterpart metric is present in the
+artifact but outside tolerance in either direction.
+
+A claim whose metric the artifact simply does not carry is a WARNING by
+default (old artifacts recorded one line, not the summary map; nothing
+to reconcile) and a failure under ``--strict``. No artifact with any
+parsed metrics at all → warning + exit 0 (nothing recorded yet).
+
+Tolerance default 0.35: README claims are best-of-repeats on a shared
+chip whose session-to-session spread is recorded at ~10-15%; the check
+is a drift tripwire, not a timing assertion.
+
+Usage::
+
+    python scripts/check_readme_claims.py [--readme README.md]
+        [--artifact BENCH_rNN.json] [--tolerance 0.35] [--strict]
+
+Stdlib only — runs anywhere, no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+# (metric key in the bench artifact, README regex capturing the claimed
+# number, multiplier mapping the captured text to the metric's unit).
+# Numbers may be written "24 155" (thousands spaces) — _num strips them.
+CLAIMS = [
+    ("ssgd_lr_steps_per_sec_per_chip",
+     r"\*\*SSGD, 1M rows\*\*:\s*([\d\s]+?)\s*steps/s/chip", 1.0),
+    ("ssgd_lr_fused_gather_steps_per_sec_per_chip",
+     r"`fused_gather` sampler at the SAME\s+geometry records\s*"
+     r"([\d\s]+?)\s*\(", 1.0),
+    ("ssgd_lr_100m_rows_steps_per_sec_per_chip",
+     r"\*\*SSGD, 100M rows\*\*:\s*([\d\s]+?)\s*steps/s", 1.0),
+    ("ssgd_lr_1b_rows_virtual_steps_per_sec_per_chip",
+     r"\*\*SSGD, 1B logical rows\*\*[^:]*:\s*([\d\s]+?)\s*steps/s", 1.0),
+    ("ma_local_sgd_local_steps_per_sec_per_chip",
+     r"\*\*MA/BMUF/EASGD\*\*.*?\(([\d\s]+?)\s*local steps/s/chip", 1.0),
+    ("kmeans_10m_iters_per_sec_per_chip",
+     r"\*\*k-means, 10M points\*\*:\s*([\d\s]+?)\s*iter/s", 1.0),
+    ("pagerank_1m_iters_per_sec",
+     r"\*\*PageRank, 1M vertices[^*]*\*\*:\s*\*\*([\d.\s]+?)\s*iter/s",
+     1.0),
+    ("als_4kx16k_sweeps_per_sec_per_chip",
+     r"\*\*ALS 4096×16384 rank-64\*\*:\s*([\d\s]+?)\s*sweeps/s", 1.0),
+    ("als_4kx16k_noisy_ridge_sweeps_per_sec_per_chip",
+     r"HARD\s+instance[^)]*?\)\s*runs\s*([\d\s]+?)\s*sweeps/s", 1.0),
+    ("ring_attention_32k_tokens_per_sec_per_chip",
+     r"32k-token forward\s+([\d.]+?)M tokens/s", 1e6),
+    ("ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip",
+     r"32k forward\+backward\s+([\d.]+?)k tokens/s", 1e3),
+    ("ring_attention_128k_tokens_per_sec_per_chip",
+     r"128k-token forward\s+([\d.]+?)k tokens/s", 1e3),
+    ("ring_attention_128k_fwd_bwd_tokens_per_sec_per_chip",
+     r"128k forward\+backward\s+~?([\d.]+?)k tokens/s", 1e3),
+]
+
+
+def _num(text: str) -> float:
+    return float(re.sub(r"\s", "", text))
+
+
+def extract_claims(readme_text: str) -> dict[str, float]:
+    """{metric: claimed value} for every claim regex that matches."""
+    out = {}
+    for metric, pattern, scale in CLAIMS:
+        m = re.search(pattern, readme_text, re.DOTALL)
+        if m:
+            out[metric] = _num(m.group(1)) * scale
+    return out
+
+
+def load_artifact_metrics(path: str | None, search_dir: str):
+    """``(artifact_name, {metric: value})`` — delegated to the shared
+    ``bench_artifacts.load_newest_metrics`` so this script and
+    bench.py's regression tripwire can never resolve "the newest parsed
+    artifact" differently."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench_artifacts
+
+    return bench_artifacts.load_newest_metrics(search_dir, path)
+
+
+def main(argv=None) -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(prog="check_readme_claims")
+    ap.add_argument("--readme", default=os.path.join(here, "README.md"))
+    ap.add_argument("--artifact", default=None,
+                    help="a specific bench artifact (default: newest "
+                         "parsed BENCH_r*.json in the repo root)")
+    ap.add_argument("--tolerance", type=float, default=0.35,
+                    help="allowed |claim/measured - 1| (default 0.35)")
+    ap.add_argument("--strict", action="store_true",
+                    help="claims whose metric the artifact lacks FAIL "
+                         "instead of warning")
+    args = ap.parse_args(argv)
+
+    with open(args.readme) as f:
+        claims = extract_claims(f.read())
+    if not claims:
+        print("check_readme_claims: no perf claims matched in "
+              f"{args.readme} — claims table out of date?",
+              file=sys.stderr)
+        return 1
+    ref, measured = load_artifact_metrics(
+        args.artifact, os.path.dirname(os.path.abspath(args.readme)))
+    if ref is None:
+        print("check_readme_claims: no bench artifact with parsed "
+              "metrics found — nothing to reconcile")
+        return 0
+
+    failures, warnings_, ok = [], [], []
+    for metric, claim in sorted(claims.items()):
+        got = measured.get(metric)
+        if not isinstance(got, (int, float)) or got <= 0:
+            warnings_.append(
+                f"  ? {metric}: claimed {claim:g}, artifact {ref} has "
+                "no such metric")
+            continue
+        ratio = claim / got
+        line = (f"{metric}: claimed {claim:g} vs measured {got:g} "
+                f"(x{ratio:.2f})")
+        if abs(ratio - 1.0) > args.tolerance:
+            failures.append("  FAIL " + line)
+        else:
+            ok.append("  ok   " + line)
+
+    print(f"check_readme_claims: {len(claims)} claims vs {ref} "
+          f"(tolerance ±{args.tolerance:.0%})")
+    for line in ok + warnings_ + failures:
+        print(line)
+    if args.strict and warnings_:
+        print(f"{len(warnings_)} claims unreconciled (--strict)")
+        return 1
+    if failures:
+        print(f"{len(failures)} claims out of tolerance — update "
+              "README.md or investigate the regression")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

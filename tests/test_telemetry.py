@@ -630,7 +630,10 @@ def test_stream_prefetch_producer_error_propagates_and_recovers(mesh4):
     w0 = jnp.zeros((meta["d_total"],), jnp.float32).at[:d].set(
         logistic.init_weights(prng.root_key(cfg.init_seed), d))
 
-    real_gather = trainer._gather
+    # the gather seam lives on the trainer's ShardedDataset since the
+    # data-subsystem port (tpu_distalg/data/) — the producer thread is
+    # pipeline.stream_staged's
+    real_gather = trainer.dataset.gather
     calls = {"n": 0}
 
     def exploding_gather(ids_step):
@@ -639,10 +642,10 @@ def test_stream_prefetch_producer_error_propagates_and_recovers(mesh4):
             raise OSError("disk read failed (injected)")
         return real_gather(ids_step)
 
-    trainer._gather = exploding_gather
+    trainer.dataset.gather = exploding_gather
     with pytest.raises(OSError, match="injected"):
         trainer.run(w0, 0, 4)
     # the trainer must stay usable after the producer died
-    trainer._gather = real_gather
+    trainer.dataset.gather = real_gather
     w, _ = trainer.run(w0, 0, 4)
     assert np.all(np.isfinite(np.asarray(w)))

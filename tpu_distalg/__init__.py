@@ -10,6 +10,9 @@ Carlo) are re-implemented as whole-loop-compiled SPMD programs.
 
 Layer map (SURVEY.md §7):
     parallel/  — mesh/runtime core + collectives/dataflow layer (replaces Spark)
+    data/      — out-of-core sharded datasets: ShardedDataset with
+                 resident/virtual/streamed backends, the packed-cache disk
+                 format, the prefetch pipeline (replaces RDD spill/stream)
     ops/       — jittable numeric kernels (replaces the per-script NumPy lambdas)
     models/    — workload entry points (replaces the reference's __main__ scripts)
     utils/     — PRNG, datasets, metrics, plotting, checkpointing
@@ -17,8 +20,8 @@ Layer map (SURVEY.md §7):
                  supervised backend init, `tda report` log summarization
 """
 
-from tpu_distalg import ops, parallel, telemetry, utils
+from tpu_distalg import data, ops, parallel, telemetry, utils
 
 __version__ = "0.1.0"
 
-__all__ = ["ops", "parallel", "telemetry", "utils", "__version__"]
+__all__ = ["data", "ops", "parallel", "telemetry", "utils", "__version__"]

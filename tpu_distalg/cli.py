@@ -57,6 +57,27 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     _add_ckpt(p, 500)
 
 
+def _add_data_backend(p, block_rows: int):
+    """The data-placement knob (tpu_distalg/data/): where the workload's
+    dataset bytes live — on-device HBM, host RAM, or a disk packed
+    cache streamed block by block. A PLACEMENT knob, not an algorithm
+    knob: staged batches are bitwise-identical across backends."""
+    p.add_argument("--data-backend", default="resident",
+                   choices=["resident", "virtual", "streamed"],
+                   help="where the dataset lives: resident = device "
+                        "HBM, virtual = host RAM, streamed = disk "
+                        "packed cache (needs --stream-cache); virtual/"
+                        "streamed stage sampled blocks through the "
+                        "prefetch pipeline (tpu_distalg/data/)")
+    p.add_argument("--stream-cache", type=str, default=None,
+                   metavar="PATH",
+                   help="packed-cache path for --data-backend "
+                        "streamed (created on first use)")
+    p.add_argument("--block-rows", type=int, default=block_rows,
+                   help="rows per gathered block (the out-of-core "
+                        "transfer granularity)")
+
+
 def _add_telemetry(p):
     """Telemetry flag — on EVERY subcommand: structured JSONL runtime
     events (marks, spans, heartbeats, stalls, restarts) for the run,
@@ -173,6 +194,14 @@ def main(argv=None):
                    help="point dimension for --scale-points")
     p.add_argument("--plot", type=str, default=None,
                    help="save a cluster scatter PNG (2-D data)")
+    _add_data_backend(p, block_rows=2048)
+    p.add_argument("--mini-batch-blocks", type=int, default=4,
+                   help="blocks per shard per minibatch step "
+                        "(minibatch engine)")
+    p.add_argument("--minibatch-steps", type=int, default=0,
+                   help="run the minibatch engine for N steps over the "
+                        "ShardedDataset (0 = classic full-batch Lloyd "
+                        "when --data-backend resident, 100 otherwise)")
     _add_ckpt(p, 100)
 
     p = sub.add_parser("pagerank")
@@ -221,6 +250,11 @@ def main(argv=None):
     p.add_argument("--k", type=int, default=10)
     p.add_argument("--lam", type=float, default=0.01)
     p.add_argument("--n-iterations", type=int, default=5)
+    _add_data_backend(p, block_rows=256)
+    p.add_argument("--rmse-every", type=int, default=1,
+                   help="streamed/virtual backends: stream one extra "
+                        "RMSE evaluation pass every N sweeps (0 = once "
+                        "after the final sweep — each pass re-reads R)")
     _add_ckpt(p, 5)
 
     p = sub.add_parser("mc", help="Monte-Carlo pi")
@@ -448,6 +482,39 @@ def _dispatch(args, jax):
         from tpu_distalg.utils import datasets
 
         mesh = _mesh(args)
+        if args.data_backend != "resident" or args.minibatch_steps:
+            # the out-of-core engine: the mixture lives behind a
+            # ShardedDataset (host RAM or a disk cache — >HBM fine) and
+            # minibatch k-means streams sampled blocks per step
+            from tpu_distalg.data import builders
+
+            if args.checkpoint_dir:
+                raise SystemExit(
+                    "--checkpoint-dir is not supported by the "
+                    "minibatch engine yet (state is tiny; rerun "
+                    "instead)")
+            if args.data_backend == "streamed" and not args.stream_cache:
+                raise SystemExit(
+                    "--data-backend streamed needs --stream-cache PATH "
+                    "(the on-disk packed cache to create or reopen)")
+            n_rows = args.scale_points or args.n_points or (1 << 20)
+            ds, _ = builders.gaussian_points_dataset(
+                mesh, n_rows, dim=args.dim, k=args.k, seed=0,
+                block_rows=args.block_rows,
+                backend=args.data_backend, path=args.stream_cache)
+            steps = args.minibatch_steps or 100
+
+            def run_once():
+                return m.fit_minibatch(
+                    ds, m.KMeansConfig(k=args.k), n_steps=steps,
+                    mini_batch_blocks=args.mini_batch_blocks)
+
+            res = ckpt.run_with_restarts(
+                run_once, max_restarts=args.max_restarts)
+            print(f"Final centers: {res.centers.tolist()}")
+            print(f"minibatch steps run: {res.n_iterations_run} "
+                  f"(backend={args.data_backend})")
+            return 0
         if args.scale_points:
             make_rows, _ = datasets.gaussian_mixture_rows(
                 k=args.k, dim=args.dim, seed=0)
@@ -567,13 +634,36 @@ def _dispatch(args, jax):
         from tpu_distalg.utils import checkpoint as ckpt
 
         mesh = _mesh(args)
-        res = ckpt.run_with_restarts(
-            lambda: m.fit(mesh, m.ALSConfig(
-                lam=args.lam, m=args.m, n=args.n, k=args.k,
-                n_iterations=args.n_iterations),
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every),
-            max_restarts=args.max_restarts)
+        cfg = m.ALSConfig(lam=args.lam, m=args.m, n=args.n, k=args.k,
+                          n_iterations=args.n_iterations)
+        if args.data_backend != "resident":
+            # R behind a ShardedDataset: host RAM or a disk cache —
+            # each sweep streams the row blocks per solve epoch, so R
+            # is bounded by disk, not HBM (models/als.fit_streamed)
+            from tpu_distalg.data import builders
+
+            if args.checkpoint_dir:
+                raise SystemExit(
+                    "--checkpoint-dir is not supported by the "
+                    "streamed ALS path yet")
+            if args.data_backend == "streamed" and not args.stream_cache:
+                raise SystemExit(
+                    "--data-backend streamed needs --stream-cache PATH "
+                    "(the on-disk packed cache to create or reopen)")
+            ds, _ = builders.rank_k_rows_dataset(
+                mesh, args.m, args.n, args.k, seed=cfg.seed,
+                block_rows=args.block_rows,
+                backend=args.data_backend, path=args.stream_cache)
+            res = ckpt.run_with_restarts(
+                lambda: m.fit_streamed(ds, cfg,
+                                       rmse_every=args.rmse_every),
+                max_restarts=args.max_restarts)
+        else:
+            res = ckpt.run_with_restarts(
+                lambda: m.fit(mesh, cfg,
+                              checkpoint_dir=args.checkpoint_dir,
+                              checkpoint_every=args.checkpoint_every),
+                max_restarts=args.max_restarts)
         for t, e in enumerate(res.rmse_history):
             print(f"iterations: {t}, rmse: {float(e):f}")
 

@@ -1,0 +1,275 @@
+"""The packed-cache format — versioned on-disk datasets, atomic publish.
+
+This is the storage half of the out-of-core dataset subsystem: a cache
+is a pair ``<path>.bin`` (a flat row-major memmap) + ``<path>.meta.json``
+(the header), optionally with named aux payloads (held-out splits,
+teacher weights). The format generalizes what
+``utils/datasets.streamed_packed_cache`` proved for the streamed SSGD
+trainer so EVERY workload (k-means points, ALS rating blocks, packed
+SSGD rows) shares one publish/validate/reopen engine instead of
+re-growing it per trainer.
+
+Header (``meta.json``) — one JSON object::
+
+    {"format": "tda-packed-cache", "version": 2,
+     "layout": "<layout name>",        # what the rows mean
+     "dtype": "<numpy/ml_dtypes name>",
+     "shape": [n_rows, row_width],
+     "geom": {...}}                    # layout-specific geometry
+
+``geom`` carries whatever the producing layout needs to validate a
+reopen (shard count, block size, generator seed, ...) — byte-for-byte
+equality against the expected geometry is the reopen contract. Caches
+written before the subsystem existed (PR 1's ``streamed_packed_cache``)
+have a FLAT geometry dict as their whole meta.json; :func:`open_cache`
+accepts those through ``legacy_geom`` so a rig's multi-GB cache is not
+regenerated over a header format change.
+
+Publish protocol (crash/concurrency-safe, lifted from
+``streamed_packed_cache`` and now the single implementation):
+
+  * every artifact is written under a PID/uuid tmp name and
+    ``os.replace``d into place — two processes pointed at the same path
+    generate independently and the LAST rename wins; content must be
+    deterministic in the header, so either winner is byte-identical;
+  * publish order is aux files → ``.bin`` → ``meta.json`` LAST: the
+    header's presence means "everything before it is complete", so
+    readers never see a partial cache whatever instant a crash hits;
+  * stale tmp orphans (a ``kill -9`` mid-generation) are swept on the
+    next build attempt, age-gated so a CONCURRENT live generator's tmp
+    files are never yanked out from under it.
+
+This module imports only numpy/stdlib (telemetry is stdlib-only too):
+cache builds run in plain host processes — tests exercise the
+two-writer race with real subprocesses.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import uuid
+
+import numpy as np
+
+from tpu_distalg.telemetry import events as tevents
+
+FORMAT = "tda-packed-cache"
+FORMAT_VERSION = 2
+# a 32 GB generation measures ~15 min on the bench rig; anything this
+# old is a crashed generator's orphan, not a live build
+STALE_TMP_SECONDS = 6 * 3600.0
+
+
+def bin_path(path: str) -> str:
+    return path + ".bin"
+
+
+def meta_path(path: str) -> str:
+    return path + ".meta.json"
+
+
+def aux_path(path: str, name: str) -> str:
+    return f"{path}.{name}"
+
+
+def make_header(*, layout: str, dtype, shape, geom: dict) -> dict:
+    return {
+        "format": FORMAT,
+        "version": FORMAT_VERSION,
+        "layout": str(layout),
+        "dtype": _dtype_name(dtype),
+        "shape": [int(x) for x in shape],
+        "geom": dict(geom),
+    }
+
+
+def _dtype_name(dtype) -> str:
+    return str(np.dtype(dtype)) if not isinstance(dtype, str) else dtype
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """``np.dtype`` from a header name, including the ml_dtypes names
+    (``bfloat16``...) numpy alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # a jax dependency — always present here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def exists(path: str) -> bool:
+    """True iff the cache is COMPLETE (header published after the bin)."""
+    return os.path.exists(meta_path(path)) and os.path.exists(bin_path(path))
+
+
+def read_header(path: str) -> dict | None:
+    if not os.path.exists(meta_path(path)):
+        return None
+    with open(meta_path(path)) as f:
+        return json.load(f)
+
+
+def open_cache(path: str, *, layout: str | None = None,
+               expect_geom: dict | None = None,
+               legacy_geom: dict | None = None):
+    """Reopen a COMPLETE cache read-only: ``(memmap, header)``.
+
+    Raises ``FileNotFoundError`` when the cache is absent/partial and
+    ``ValueError`` on any header mismatch — wrong format marker, a
+    version this reader does not speak, a different layout, or geometry
+    that differs from ``expect_geom`` (the caller's generation
+    parameters: reopening a cache built with other ones would silently
+    train on the wrong bytes).
+
+    ``legacy_geom``: pre-subsystem caches (PR 1) wrote the flat geometry
+    dict as their entire meta.json; when it equals ``legacy_geom`` the
+    cache is accepted and wrapped in a synthetic v1 header (``dtype``/
+    ``shape`` taken from ``legacy_geom``'s producer via ``expect_geom``
+    is not possible, so callers supply them through the returned
+    header's ``geom`` as before).
+    """
+    header = read_header(path)
+    if header is None or not os.path.exists(bin_path(path)):
+        raise FileNotFoundError(
+            f"no complete packed cache at {path!r} (meta.json is "
+            "published last — a .bin without it is a half-finished "
+            "build)")
+    if "format" not in header:
+        # legacy flat-geometry meta (pre-versioned caches)
+        if legacy_geom is None or header != legacy_geom:
+            raise ValueError(
+                f"cache at {path} has a legacy header {header} that "
+                f"does not match the expected geometry "
+                f"{legacy_geom}; delete it or use another path")
+        header = {"format": FORMAT, "version": 1, "layout": layout or "",
+                  "dtype": None, "shape": None, "geom": dict(legacy_geom)}
+        mm = None  # legacy caller opens the memmap itself (knows dtype)
+        return mm, header
+    if header.get("format") != FORMAT:
+        raise ValueError(
+            f"cache at {path} is not a {FORMAT} artifact "
+            f"(format={header.get('format')!r})")
+    if header.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"cache at {path} has format version "
+            f"{header.get('version')!r}; this reader speaks "
+            f"{FORMAT_VERSION} — regenerate the cache (or upgrade)")
+    if layout is not None and header.get("layout") != layout:
+        raise ValueError(
+            f"cache at {path} holds layout {header.get('layout')!r}, "
+            f"wanted {layout!r}")
+    if expect_geom is not None and header.get("geom") != expect_geom:
+        raise ValueError(
+            f"cache at {path} was built with {header.get('geom')}, "
+            f"wanted {expect_geom}; delete it or use another path")
+    dtype = resolve_dtype(header["dtype"])
+    shape = tuple(header["shape"])
+    mm = np.memmap(bin_path(path), dtype=dtype, mode="r", shape=shape)
+    return mm, header
+
+
+def shard_rows(n_rows: int, n_shards: int, shard: int) -> tuple[int, int]:
+    """Shard-aware slicing: the contiguous ``[lo, hi)`` row range shard
+    ``shard`` owns (rows divide the shards exactly — the no-padding-rows
+    memmap contract every builder enforces)."""
+    if n_rows % n_shards:
+        raise ValueError(
+            f"{n_rows} cache rows do not divide {n_shards} shards")
+    per = n_rows // n_shards
+    return shard * per, (shard + 1) * per
+
+
+def shard_view(mm: np.ndarray, n_shards: int, shard: int) -> np.ndarray:
+    """Zero-copy view of one shard's contiguous row range."""
+    lo, hi = shard_rows(mm.shape[0], n_shards, shard)
+    return mm[lo:hi]
+
+
+def sweep_stale_tmp(path: str) -> None:
+    """Remove tmp orphans of CRASHED generations of THIS cache. Globs
+    are anchored to the exact artifact names — a bare ``path + '*'``
+    would match a sibling cache sharing the prefix (``/data/cache`` vs
+    ``/data/cache_big``) and yank its live tmp files. Age-gated so a
+    concurrent live generator (minutes old) is never swept."""
+    now = time.time()
+    for pat in (bin_path(path) + ".tmp.*", meta_path(path) + ".tmp.*",
+                path + ".*.tmp.*"):
+        for stale in glob.glob(pat):
+            try:
+                if now - os.path.getmtime(stale) > STALE_TMP_SECONDS:
+                    os.remove(stale)
+            except OSError:
+                pass  # a concurrent generator may have just published
+
+
+def build_cache(path: str, *, header: dict, write_bin, aux=()):
+    """Generate and ATOMICALLY publish a cache; returns the read-only
+    reopened ``(memmap, header)``.
+
+    ``write_bin(memmap)`` fills the ``header['shape']`` memmap (opened
+    ``w+`` in the header dtype) — it may call
+    ``telemetry.events.mark`` per chunk so a multi-minute generation
+    reads as progress, not a stall. ``aux`` is a sequence of
+    ``(name, write_fn)``: each payload is written via
+    ``write_fn(tmp_path)`` and published (atomically, BEFORE the bin)
+    as ``<path>.<name>``.
+
+    Content MUST be deterministic in the header: two concurrent
+    builders both publish, the last rename wins, and either winner is
+    byte-identical. The whole build runs inside a
+    ``data:cache_build`` telemetry span.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    sweep_stale_tmp(path)
+    dtype = resolve_dtype(header["dtype"])
+    shape = tuple(header["shape"])
+    tmp_tag = f".tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    bin_tmp = bin_path(path) + tmp_tag
+    meta_tmp = meta_path(path) + tmp_tag
+    aux_tmps = [(aux_path(path, name), aux_path(path, name) + tmp_tag, fn)
+                for name, fn in aux]
+    tmps = [bin_tmp, meta_tmp] + [t for _, t, _ in aux_tmps]
+    try:
+        with tevents.span("data:cache_build", path=path,
+                          layout=header.get("layout"),
+                          bytes=int(np.prod(shape)) * dtype.itemsize):
+            mm = np.memmap(bin_tmp, dtype=dtype, mode="w+", shape=shape)
+            write_bin(mm)
+            mm.flush()
+            del mm
+            for final, tmp, fn in aux_tmps:
+                fn(tmp)
+                os.replace(tmp, final)
+            os.replace(bin_tmp, bin_path(path))
+            with open(meta_tmp, "w") as f:
+                json.dump(header, f)
+            os.replace(meta_tmp, meta_path(path))
+    finally:
+        # a failed generation must not orphan multi-GB tmp bytes
+        # (kill -9 still can — sweep_stale_tmp catches those next call)
+        for leftover in tmps:
+            try:
+                os.remove(leftover)
+            except OSError:
+                pass  # already renamed away (success) or never created
+    return open_cache(path, layout=header.get("layout"),
+                      expect_geom=header.get("geom"))
+
+
+def open_or_build(path: str, *, header: dict, write_bin, aux=(),
+                  legacy_geom: dict | None = None):
+    """The create-or-reopen entry every builder uses: a complete cache
+    with a matching header reopens at O(ms); anything else generates
+    (mismatched geometry raises from :func:`open_cache` first, loudly).
+    ``legacy_geom`` flows through to :func:`open_cache` so pre-versioned
+    caches reopen instead of erroring on the header change."""
+    if exists(path):
+        return open_cache(path, layout=header.get("layout"),
+                          expect_geom=header.get("geom"),
+                          legacy_geom=legacy_geom)
+    return build_cache(path, header=header, write_bin=write_bin, aux=aux)

@@ -335,6 +335,118 @@ def fit(points: np.ndarray, mesh: Mesh,
     )
 
 
+def make_minibatch_step_fn(mesh: Mesh, k: int, dim: int):
+    """Jitted minibatch-k-means step over one STAGED batch from a
+    ``ShardedDataset`` in the ``points_valid_f32`` layout
+    (``data/builders.py``): per shard, assign + masked cluster stats
+    over the staged rows, one psum, then the Sculley (2010) web-scale
+    update — per-center learning rate ``count_c / n_seen_c`` so each
+    center converges as the harmonic mean of its minibatch means.
+    ``step(staged, centers, n_seen) -> (centers, n_seen)``; arithmetic
+    is identical whichever backend staged the batch, so trajectories
+    are bitwise-equal across resident/virtual/streamed
+    (tests/test_data.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_distalg.ops import kmeans as kops
+
+    def _local(staged, centers):
+        rows = staged[0]
+        pts, m = rows[:, :dim], rows[:, dim]
+        assign = kops.assign_clusters(pts, centers)
+        sums, counts = kops.cluster_stats(pts, m, assign, k)
+        return tree_allreduce_sum((sums, counts))
+
+    stats_fn = data_parallel(
+        _local, mesh,
+        in_specs=(P("data", None, None), P()),
+        out_specs=(P(), P()),
+    )
+
+    def step(staged, centers, n_seen):
+        sums, counts = stats_fn(staged, centers)
+        n_seen = n_seen + counts
+        eta = jnp.where(n_seen > 0, counts / jnp.maximum(n_seen, 1.0),
+                        0.0)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        centers = jnp.where(counts[:, None] > 0,
+                            centers + eta[:, None] * (means - centers),
+                            centers)
+        return centers, n_seen
+
+    return jax.jit(step)
+
+
+def init_centers_from_dataset(dataset, k: int, seed: int) -> jax.Array:
+    """Greedy farthest-point init over the dataset's FIRST block
+    (shard 0) — O(block) host cost, identical whichever backend holds
+    the bytes (the staged block is bitwise-equal across backends).
+    Farthest-point, not a random k-sample: random init merges clusters
+    with probability ≈1−k!/kᵏ (98.5% at k=6) and the minibatch update
+    cannot split a merged pair — the same Lloyd local optimum
+    :func:`init_centers_farthest` documents for the resident scale
+    path."""
+    block0 = np.asarray(
+        dataset.stage(np.zeros((dataset.n_shards, 1), np.int64)))[0]
+    dim = block0.shape[1] - 1
+    valid = block0[:, dim] > 0
+    pts = block0[valid][:, :dim]
+    if k > pts.shape[0]:
+        raise ValueError(
+            f"cannot sample k={k} centers from a {pts.shape[0]}-row "
+            "first block; raise block_rows")
+    rng = np.random.default_rng(seed)
+    chosen = [int(rng.integers(0, pts.shape[0]))]
+    d = np.linalg.norm(pts - pts[chosen[0]], axis=1)
+    while len(chosen) < k:
+        nxt = int(d.argmax())
+        chosen.append(nxt)
+        d = np.minimum(d, np.linalg.norm(pts - pts[nxt], axis=1))
+    return jnp.asarray(pts[chosen], jnp.float32)
+
+
+def fit_minibatch(dataset, config: KMeansConfig, *, n_steps: int,
+                  mini_batch_blocks: int = 4,
+                  centers0=None) -> KMeansResult:
+    """Minibatch k-means over a :class:`~tpu_distalg.data.ShardedDataset`
+    — the >HBM Lloyd replacement this repo previously had only for SSGD
+    (VERDICT "what's missing" #3): per step, ``mini_batch_blocks``
+    blocks per shard are drawn with the SAME host-side threefry sampler
+    the streamed SSGD trainer uses (keyed on the absolute step id, so
+    runs are deterministic), staged through the prefetch pipeline
+    (gather ∥ H2D ∥ compute for host backends), and folded into the
+    centers with the Sculley update. The dataset must be in the
+    ``points_valid_f32`` layout (``data/builders.py``); padding rows
+    carry valid 0 and are inert."""
+    from tpu_distalg.data import make_host_block_sampler
+
+    import contextlib
+
+    dim = int(dataset.meta.get("dim", dataset.pd - 1))
+    ns = min(mini_batch_blocks, dataset.n_blocks)
+    draw = make_host_block_sampler(
+        config.seed, dataset.n_shards, dataset.n_blocks, ns)
+    ids = draw(np.arange(n_steps))
+    if centers0 is None:
+        centers0 = init_centers_from_dataset(
+            dataset, config.k, config.seed)
+    step = make_minibatch_step_fn(dataset.mesh, config.k, dim)
+    centers = jnp.asarray(centers0, jnp.float32)
+    n_seen = jnp.zeros((config.k,), jnp.float32)
+    serialize = not dataset.on_tpu
+    with contextlib.closing(dataset.stream(ids)) as batches:
+        for staged in batches:
+            centers, n_seen = step(staged, centers, n_seen)
+            if serialize:
+                jax.block_until_ready(centers)
+    from tpu_distalg.utils import metrics
+
+    metrics.guard_finite(centers, "minibatch k-means centers")
+    return KMeansResult(centers=centers,
+                        assignments=jnp.zeros((0,), jnp.int32),
+                        n_iterations_run=n_steps)
+
+
 def init_centers_scaled(make_rows, n_rows: int,
                         config: KMeansConfig) -> jax.Array:
     """The scale path's ``config.init`` dispatch — one place, shared by

@@ -25,13 +25,15 @@ TPU-native shape of the answer:
     ASYNCHRONOUSLY onto the mesh (sharded over the data axis);
   * the staging of step t+1 is enqueued BEFORE step t's gradient is
     dispatched (double buffering), and the HOST GATHER runs on a
-    background prefetch thread (``_gather`` producer → maxsize-1
-    queue → ``_put`` on the dispatch thread; at most two gathered
-    batches resident beyond the one in compute): gather(t+2),
-    H2D(t+1) and compute(t) genuinely overlap, so the steady-state
-    rate is max(gather, H2D, compute) — not their serial sum (before
-    round 6 the gather ran synchronously on the dispatch thread, which
-    for a disk-memmap >RAM dataset made it gather + min(H2D, compute));
+    background prefetch thread — since PR 2 both live in the data
+    subsystem (``tpu_distalg/data``: ``ShardedDataset`` owns the
+    storage/gather/put, ``pipeline.stream_staged`` the producer →
+    maxsize-1 queue → put loop; at most two gathered batches resident
+    beyond the one in compute): gather(t+2), H2D(t+1) and compute(t)
+    genuinely overlap, so the steady-state rate is max(gather, H2D,
+    compute) — not their serial sum (before round 6 the gather ran
+    synchronously on the dispatch thread, which for a disk-memmap >RAM
+    dataset made it gather + min(H2D, compute));
   * the device step feeds the staged blocks to the SAME kernel the
     resident path runs (``fused_grad_sum_gathered`` with the identity
     block index), so the weight trajectory is bitwise-identical to
@@ -45,21 +47,21 @@ straight runs, like every other sampler.
 
 from __future__ import annotations
 
+import contextlib
 import functools
-import queue
-import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_distalg.data import ShardedDataset, make_host_block_sampler
 from tpu_distalg.models.ssgd import (
     SSGDConfig,
     TrainResult,
     fused_gather_geometry,
 )
-from tpu_distalg.ops import logistic, pallas_kernels, sampling
+from tpu_distalg.ops import logistic, pallas_kernels
 from tpu_distalg.parallel import DATA_AXIS, data_parallel, \
     tree_allreduce_sum
 from tpu_distalg.utils import metrics, prng
@@ -81,23 +83,11 @@ def pack_host(X, y, mesh: Mesh, config: SSGDConfig):
 
 def make_host_sampler(seed: int, n_shards: int, n_blocks: int,
                       n_sampled: int):
-    """Build ONCE the jitted 'fused_gather' block draw on the host CPU
-    backend: threefry is platform-deterministic, so these ids equal the
-    ones the resident path draws on device. Returns
-    ``draw(ts) -> (T, n_shards, n_sampled)``; the jit is cached per
-    distinct segment length (building it per call would recompile the
-    sampler inside timed/checkpointed loops)."""
-    key = prng.root_key(seed)
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        f = jax.jit(jax.vmap(lambda t: sampling.sample_block_ids(
-            jax.random.fold_in(key, t), n_shards, n_blocks, n_sampled)))
-
-    def draw(ts: np.ndarray) -> np.ndarray:
-        with jax.default_device(cpu):
-            return np.asarray(f(jnp.asarray(ts, jnp.int32)))
-
-    return draw
+    """The host-CPU 'fused_gather' block draw — now the data
+    subsystem's ``pipeline.make_host_block_sampler`` (kept as an alias:
+    the sampler IS the bitwise-equality contract and callers reference
+    it here)."""
+    return make_host_block_sampler(seed, n_shards, n_blocks, n_sampled)
 
 
 def host_block_ids(config: SSGDConfig, n_shards: int, n_blocks: int,
@@ -145,7 +135,11 @@ def make_step_fn(mesh: Mesh, config: SSGDConfig, meta: dict,
 
 class StreamTrainer:
     """The double-buffered host→device training loop over a packed
-    host (or memmap) matrix. Build once, then :meth:`run` segments."""
+    host (or memmap) matrix. Build once, then :meth:`run` segments.
+    Storage, gather, H2D staging and the prefetch pipeline live in the
+    data subsystem (``tpu_distalg/data/`` — this trainer is where the
+    machinery was proven before being promoted); what remains here is
+    the SSGD-specific step/eval logic."""
 
     def __init__(self, X2_host, meta: dict, mesh: Mesh,
                  config: SSGDConfig, X_test=None, y_test=None):
@@ -156,17 +150,19 @@ class StreamTrainer:
                 f"packed rows {n2} not divisible by {n_shards} shards "
                 "— pack with block_rows=gather_block_rows*n_shards "
                 "(pack_host does)")
-        self.X2 = X2_host
         self.meta = meta
         self.mesh = mesh
         self.config = config
         self.bp = config.gather_block_rows // meta["pack"]
-        self.n2_local = n2 // n_shards
         self.n_shards = n_shards
+        self.dataset = ShardedDataset(X2_host, mesh,
+                                      block_rows=self.bp, meta=meta)
+        self.X2 = self.dataset.storage
+        self.n2_local = self.dataset.n2_local
         # same quantization (and warning) as the resident path
         n_blocks, n_sampled = fused_gather_geometry(
             config, meta, n_shards)
-        if n_blocks != self.n2_local // self.bp:
+        if n_blocks != self.dataset.n_blocks:
             raise ValueError(
                 f"meta n_padded={meta['n_padded']} disagrees with the "
                 f"host matrix ({n2} packed rows)")
@@ -174,24 +170,14 @@ class StreamTrainer:
         self._draw = make_host_sampler(config.seed, n_shards, n_blocks,
                                        n_sampled)
         self.step_fn = make_step_fn(mesh, config, meta, n_sampled)
-        self.shard_spec = NamedSharding(mesh, P(DATA_AXIS, None, None))
-        self._row_offsets = (
-            np.arange(n_shards)[:, None] * self.n2_local)
-        # full-array reduction, PER SHARD (axes 1,2 only): the touch
-        # runs concurrently with the previous step's program, and two
-        # in-flight collective programs can deadlock a rendezvous on
-        # backends that may start them out of order (seen on the CPU
-        # mesh) — so the touch must contain NO cross-device collective.
-        # A partial read must not satisfy it either.
-        self._touch = jax.jit(
-            lambda a: jnp.sum(a.astype(jnp.float32), axis=(1, 2)))
+        self.shard_spec = self.dataset.shard_spec
+        self._touch = self.dataset._touch
         # CPU-mesh emulation on few host cores starves the rendezvous
         # when several multi-device programs are in flight (collective
         # thunks BLOCK pool workers; a 1-core host then never schedules
         # the remaining participants) — run one step at a time there.
         # Pipelining is a hardware-rig concern anyway.
-        self._serialize = (
-            next(iter(mesh.devices.flat)).platform != "tpu")
+        self._serialize = not self.dataset.on_tpu
         self.eval_fn = None
         if config.eval_test:
             if X_test is None:
@@ -216,37 +202,23 @@ class StreamTrainer:
                 mesh, in_specs=(P(), P(), P()), out_specs=P(),
             ))
             self._eval_args = (Xt, yt)
-        self.h2d_bytes_per_step = int(
-            n_shards * n_sampled * self.bp * self.X2.shape[1]
-            * self.X2.dtype.itemsize)
+        self.h2d_bytes_per_step = self.dataset.h2d_bytes_per_step(
+            n_sampled)
 
     def _gather(self, ids_step: np.ndarray) -> np.ndarray:
-        """The HOST side of staging one step: the fancy-index gather of
-        the sampled blocks out of the (possibly disk-memmap) matrix —
-        for a >RAM dataset this is the dominant per-step cost, which is
-        why :meth:`run` executes it on the prefetch thread. Pure numpy:
-        safe off the JAX dispatch thread."""
-        rows = (ids_step[:, :, None] * self.bp
-                + np.arange(self.bp)[None, None, :]).reshape(
-                    self.n_shards, -1)
-        rows = rows + self._row_offsets
-        return self.X2[rows]
+        """Host-side gather of one step's sampled blocks — now
+        ``ShardedDataset.gather`` (kept for the tests/bench that probe
+        the stages individually)."""
+        return self.dataset.gather(ids_step)
 
     def _put(self, gathered: np.ndarray):
-        """The DEVICE side: async H2D of one gathered (S, ns·bp, pd)
-        batch onto the mesh, TOUCHED with a tiny async reduction so the
-        transfer actually starts now — on tunneled/lazy backends
-        ``device_put`` (and even ``block_until_ready`` on its result)
-        can defer the copy until first use, which would serialize the
-        H2D behind the next step instead of overlapping it."""
-        staged = jax.device_put(gathered, self.shard_spec)
-        self._touch(staged)  # async; result dropped
-        return staged
+        """Async H2D staging — now ``ShardedDataset.put``."""
+        return self.dataset.put(gathered)
 
     def _stage(self, ids_step: np.ndarray):
         """Serial gather+put of one step's batch — the shape bench.py's
         H2D-roofline probe measures on purpose (no prefetch)."""
-        return self._put(self._gather(ids_step))
+        return self.dataset.stage(ids_step)
 
     def run(self, w, t0: int, n_steps: int, acc0=0.0):
         """``n_steps`` double-buffered steps from absolute step ``t0``;
@@ -255,17 +227,15 @@ class StreamTrainer:
         segment boundaries). Device values only are carried — no host
         sync until the final fetch.
 
-        The host gather runs on a background prefetch thread behind a
-        maxsize-1 queue: gather(t+2) ∥ H2D(t+1) ∥ compute(t). Host
-        residency is bounded at up to two gathered batches beyond the
-        one in compute — one staged-ready in the queue plus the one
-        being gathered (the queue bounds the QUEUE depth at one; the
-        producer's in-flight gather is the second). Block order and
-        content are identical to the serial path, so the weight
-        trajectory stays bitwise-equal to the resident 'fused_gather'
-        sampler. A producer-side
-        exception is forwarded through the queue and re-raised here;
-        on any exit the producer is stopped and joined."""
+        The host gather runs on the data subsystem's prefetch pipeline
+        (``data/pipeline.stream_staged``): a background producer thread
+        behind a maxsize-1 queue, so gather(t+2) ∥ H2D(t+1) ∥
+        compute(t) and host residency is bounded at two gathered
+        batches beyond the one in compute. Block order and content are
+        identical to the serial path, so the weight trajectory stays
+        bitwise-equal to the resident 'fused_gather' sampler. A
+        producer-side exception is re-raised here; on any exit the
+        producer is stopped and joined (``contextlib.closing``)."""
         from tpu_distalg.telemetry import events as tevents
 
         cfg = self.config
@@ -273,43 +243,9 @@ class StreamTrainer:
         ids = self._draw(ts)
         accs = []
         last_acc = jnp.float32(acc0)
-        halt = threading.Event()
-        q: queue.Queue = queue.Queue(maxsize=1)
-
-        def offer(item) -> bool:
-            while not halt.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                for i in range(n_steps):
-                    if not offer(self._gather(ids[i])):
-                        return
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                offer(e)
-
-        def next_batch():
-            item = q.get()
-            if isinstance(item, BaseException):
-                raise item
-            return item
-
-        th = None
-        if n_steps:
-            th = threading.Thread(target=producer, daemon=True,
-                                  name="tda-stream-prefetch")
-            th.start()
-        try:
-            staged = self._put(next_batch()) if n_steps else None
-            for i in range(n_steps):
+        with contextlib.closing(self.dataset.stream(ids)) as batches:
+            for i, staged in enumerate(batches):
                 tevents.mark("ssgd_stream:step", emit_event=False)
-                nxt = (self._put(next_batch()) if i + 1 < n_steps
-                       else None)
                 w = self.step_fn(staged, w)
                 if self._serialize:
                     jax.block_until_ready(w)
@@ -319,11 +255,6 @@ class StreamTrainer:
                     accs.append(last_acc)
                 else:
                     accs.append(last_acc)
-                staged = nxt
-        finally:
-            halt.set()
-            if th is not None:
-                th.join(timeout=10.0)
         return w, jnp.stack(accs) if accs else jnp.zeros((0,))
 
 

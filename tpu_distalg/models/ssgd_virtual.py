@@ -62,11 +62,14 @@ def _geometry(config: SSGDConfig, data: VirtualData, n_shards: int):
     """Blocks per shard and blocks sampled per shard per step — the
     'fused_gather' block-cluster sampling on a virtual row space padded
     up to a whole number of blocks per shard (padding rows carry zero
-    mask via ``row_id >= n_rows``)."""
-    br = config.gather_block_rows
-    rows_per_shard = -(-data.n_rows // (n_shards * br)) * br
-    n_blocks = rows_per_shard // br
-    n_sampled = max(1, round(config.mini_batch_fraction * n_blocks))
+    mask via ``row_id >= n_rows``). The grid itself is the data
+    subsystem's shared ``block_geometry`` (every out-of-core path —
+    virtual, streamed, minibatch k-means — samples the same grid)."""
+    from tpu_distalg.data import block_geometry
+
+    rows_per_shard, n_blocks, n_sampled = block_geometry(
+        data.n_rows, config.gather_block_rows, n_shards,
+        config.mini_batch_fraction)
     warn_quantized_fraction(
         "virtual", n_blocks, n_sampled, config.mini_batch_fraction,
         "lower gather_block_rows for a finer grid")

@@ -185,13 +185,18 @@ def streamed_packed_cache(path: str, n_rows: int, n_features: int, *,
     trainer must move, exactly the situation Spark's spill/stream
     handles for the reference (``ssgd.py:86``). Returns
     ``(memmap X2, meta, (X_test, y_test))``; an existing cache with
-    matching geometry is reopened read-only at O(ms)."""
-    import json
-    import os
-    import time
+    matching geometry is reopened read-only at O(ms).
 
+    The disk format and publish protocol are the data subsystem's
+    generalized packed cache (``tpu_distalg/data/cache.py`` — the
+    engine was lifted OUT of this function in PR 2): versioned header,
+    atomic aux→bin→meta publish, PID/uuid tmp names with a stale-orphan
+    sweep. Caches written before the versioned header (flat geometry
+    dict as the whole meta.json) reopen unchanged via the legacy path —
+    a rig's multi-GB cache survives the format promotion."""
     import jax.numpy as jnp
 
+    from tpu_distalg.data import cache as dcache
     from tpu_distalg.ops import pallas_kernels
 
     d = n_features + 1  # + bias, like the resident flagship task
@@ -209,22 +214,7 @@ def streamed_packed_cache(path: str, n_rows: int, n_features: int, *,
                 x_dtype=str(x_dtype), n_test=n_test)
     meta = dict(pack=pack, d_total=d_t, y_col=y_col, v_col=v_col,
                 n_padded=n_rows)
-    bin_path, json_path = path + ".bin", path + ".meta.json"
     test_path = path + ".test.npz"
-    # meta.json is published LAST (tmp+rename below), so its presence
-    # marks a COMPLETE cache; a .bin without it is a half-finished
-    # publish (crash between the renames) and is regenerated over
-    if os.path.exists(json_path) and os.path.exists(bin_path):
-        with open(json_path) as f:
-            saved = json.load(f)
-        if saved != geom:
-            raise ValueError(
-                f"cache at {path} was built with {saved}, "
-                f"wanted {geom}; delete it or use another path")
-        X2 = np.memmap(bin_path, dtype=np_dtype, mode="r",
-                       shape=(n2, pd))
-        t = np.load(test_path)
-        return X2, meta, (t["X"], t["y"])
 
     if np_dtype.itemsize != 2:
         raise ValueError(
@@ -268,39 +258,13 @@ def streamed_packed_cache(path: str, n_rows: int, n_features: int, *,
         y = (g.random(n, dtype=np.float32) < p)
         return (EXP0 | m | (sgn << np.uint16(15))), y
 
-    # PID/uuid-suffixed tmp names: two processes pointed at the same
-    # cache path (bench + CLI) generate independently and the LAST
-    # atomic rename wins — content is deterministic in (seed, geometry),
-    # so either winner is byte-identical; a fixed '.tmp' name let them
-    # overwrite each other mid-generation and publish interleaved bytes
-    import glob as _glob
-    import uuid as _uuid
-
-    # sweep orphans from CRASHED/killed generations (unique names mean
-    # no later run overwrites them; the finally below cannot catch
-    # kill -9 or the watchdog's os._exit — at 32 GB apiece a few would
-    # fill the disk). Age-gated so a CONCURRENT live generator's tmp
-    # (minutes old, same path) is never yanked out from under it.
-    stale_after = 6 * 3600.0  # a 32 GB generation measures ~15 min
-    now = time.time()
-    # globs anchored to THIS cache's exact artifact names — a bare
-    # `path + "*"` would match a sibling cache sharing the prefix
-    # (/data/cache vs /data/cache_big) and yank its live tmp files
-    for pat in (bin_path + ".tmp.*", path + ".test.tmp.*",
-                json_path + ".tmp.*"):
-        for stale in _glob.glob(pat):
-            try:
-                if now - os.path.getmtime(stale) > stale_after:
-                    os.remove(stale)
-            except OSError:
-                pass  # a concurrent generator may have just published
-    tmp_tag = f".tmp.{os.getpid()}.{_uuid.uuid4().hex[:8]}"
-    bin_tmp = bin_path + tmp_tag
-    test_tmp = path + ".test" + tmp_tag + ".npz"
-    json_tmp = json_path + tmp_tag
-    try:
-        X2 = np.memmap(bin_tmp, dtype=np.uint16, mode="w+",
-                       shape=(n2, pd))
+    def write_bin(mm):
+        # bf16 memmap viewed as its uint16 bit patterns — the generator
+        # works in raw bits (the f32 + astype path measured ~8x slower).
+        # NOTE: `rng` is the OUTER stream, continued after the teacher
+        # draw above — recreating it here would change the bytes vs
+        # every cache generated before the engine extraction.
+        X2u = mm.view(np.uint16)
         chunk = chunk_rows - (chunk_rows % pack)
         out = np.zeros((chunk, d_t), np.uint16)
         from tpu_distalg.telemetry import events as tevents
@@ -316,33 +280,29 @@ def streamed_packed_cache(path: str, n_rows: int, n_features: int, *,
             out[:n_c, :d] = bits
             out[:n_c, y_col] = np.where(yc, ONE, np.uint16(0))
             out[:n_c, v_col] = ONE
-            X2[lo // pack:(lo + n_c) // pack] = out[:n_c].reshape(
+            X2u[lo // pack:(lo + n_c) // pack] = out[:n_c].reshape(
                 n_c // pack, pd)
-        X2.flush()
+
+    def write_test(tmp_path):
         g2 = np.random.default_rng(seed + 1)
         bits_t, y_test = gen_bits(n_test, g2)
         # feature VALUES as the device sees them: ±(1 + m/128)
         X_test = _values(bits_t & np.uint16(0x7F),
                          bits_t >> np.uint16(15))
-        y_test = y_test.astype(np.float32)
-        # publish order: test split, then .bin, then meta.json LAST —
-        # each via tmp+atomic-rename. meta's presence == "everything
-        # before it is complete", so readers (which require meta AND
-        # bin above) never see a partial cache, whatever instant a
-        # crash hits.
-        np.savez(test_tmp, X=X_test, y=y_test, w_true=w_true)
-        os.replace(test_tmp, test_path)
-        os.replace(bin_tmp, bin_path)
-        with open(json_tmp, "w") as f:
-            json.dump(geom, f)
-        os.replace(json_tmp, json_path)
-    finally:
-        # a failed generation must not orphan up to 32 GB of tmp bytes
-        # (kill -9 still can — the sweep above catches those next call)
-        for leftover in (bin_tmp, test_tmp, json_tmp):
-            try:
-                os.remove(leftover)
-            except OSError:
-                pass  # already renamed away (success) or never created
-    X2 = np.memmap(bin_path, dtype=np_dtype, mode="r", shape=(n2, pd))
-    return X2, meta, (X_test, y_test)
+        # a FILE handle: np.savez on a path appends '.npz', which would
+        # break the engine's tmp→final rename
+        with open(tmp_path, "wb") as f:
+            np.savez(f, X=X_test, y=y_test.astype(np.float32),
+                     w_true=w_true)
+
+    header = dcache.make_header(layout="packed_augmented",
+                                dtype=str(x_dtype), shape=(n2, pd),
+                                geom=geom)
+    X2, _hdr = dcache.open_or_build(
+        path, header=header, write_bin=write_bin,
+        aux=[("test.npz", write_test)], legacy_geom=geom)
+    if X2 is None:  # pre-versioned cache (flat geom meta.json)
+        X2 = np.memmap(dcache.bin_path(path), dtype=np_dtype, mode="r",
+                       shape=(n2, pd))
+    t = np.load(test_path)
+    return X2, meta, (t["X"], t["y"])
