@@ -1383,9 +1383,19 @@ def main(argv=None):
                              "attempts, every metric) into DIR; "
                              "$TDA_TELEMETRY_DIR is the default; "
                              "summarize with 'tda report DIR'")
+    parser.add_argument("--fault-plan", type=str, default=None,
+                        metavar="SPEC",
+                        help="deterministic fault-injection plan "
+                             "(tpu_distalg/faults/): bench the recovery "
+                             "machinery's overhead under a replayable "
+                             "fault schedule; $TDA_FAULT_PLAN is the "
+                             "default")
     args = parser.parse_args(argv)
 
     tevents.configure(args.telemetry_dir)
+    from tpu_distalg import faults as tfaults
+
+    tfaults.configure(args.fault_plan)
     # phase-stall watchdog: replaces the absolute-timer _watchdog thread
     # (and fixes its summary/print race by construction — one lock)
     hb = theartbeat.Heartbeat(

@@ -483,11 +483,11 @@ def test_workload_checkpoint_dirs_not_interchangeable(mesh4, tmp_path):
                    checkpoint_dir=d, checkpoint_every=4)
 
 
-def test_corrupt_checkpoint_quarantined_by_watchdog(mesh8, data, tmp_path):
-    """Advisor r4: a checkpoint half-written by the crash being survived
-    used to kill the watchdog (restore's ValueError was treated as a
-    config error). It must instead quarantine the corrupt file and
-    resume from the previous step — bitwise-equal to a straight run."""
+def test_corrupt_checkpoint_falls_back_in_process(mesh8, data, tmp_path):
+    """Advisor r4's quarantine scenario, upgraded by PR 3: a corrupt
+    NEWEST checkpoint no longer even costs a ``run_with_restarts``
+    cycle — the resume path quarantines it and falls back to the
+    next-older step IN-PROCESS, bitwise-equal to a straight run."""
     import os
 
     from tpu_distalg.utils import checkpoint as ckpt
@@ -501,28 +501,248 @@ def test_corrupt_checkpoint_quarantined_by_watchdog(mesh8, data, tmp_path):
     with open(newest, "wb") as f:
         f.write(b"\xff\xfe not msgpack")
 
-    # without retries the corrupt file is a hard error (carries path)
-    with pytest.raises(ckpt.CorruptCheckpointError):
-        ssgd.train(X_train, y_train, X_test, y_test, mesh8,
-                   ssgd.SSGDConfig(n_iterations=120),
-                   checkpoint_dir=d, checkpoint_every=30)
-
-    msgs = []
-    resumed = ckpt.run_with_restarts(
-        lambda: ssgd.train(X_train, y_train, X_test, y_test, mesh8,
-                           ssgd.SSGDConfig(n_iterations=120),
-                           checkpoint_dir=d, checkpoint_every=30),
-        max_restarts=1, logger=msgs.append)
-    assert any("quarantine" in m for m in msgs)
-    # quarantine retries must NOT consume the restart budget (r4 review:
-    # a crash that also corrupts the newest checkpoint would otherwise
-    # exhaust max_restarts=1 before reaching the corrupt file)
-    assert any("0/1 used" in m for m in msgs)
+    # direct resume — no watchdog wrapper anywhere in sight
+    resumed = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                         ssgd.SSGDConfig(n_iterations=120),
+                         checkpoint_dir=d, checkpoint_every=30)
     assert os.path.exists(newest + ".corrupt")
     straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
                           ssgd.SSGDConfig(n_iterations=120))
     np.testing.assert_array_equal(np.asarray(straight.w),
                                   np.asarray(resumed.w))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(resumed.accs))
+
+
+def test_all_checkpoints_corrupt_means_fresh_start(mesh8, data, tmp_path):
+    """When EVERY checkpoint is corrupt the fallback walks the whole
+    chain, quarantines each, and restarts from step 0 — still
+    bitwise-equal to a straight run, never an unhandled error."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    X_train, y_train, X_test, y_test = data
+    d = str(tmp_path / "ck")
+    ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+               ssgd.SSGDConfig(n_iterations=60),
+               checkpoint_dir=d, checkpoint_every=30)
+    for name in list(os.listdir(d)):
+        if name.endswith(".msgpack"):
+            with open(os.path.join(d, name), "wb") as f:
+                f.write(b"junk")
+    resumed = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                         ssgd.SSGDConfig(n_iterations=60),
+                         checkpoint_dir=d, checkpoint_every=30)
+    assert ckpt.latest_step(d) == 60  # re-ran and re-checkpointed
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8,
+                          ssgd.SSGDConfig(n_iterations=60))
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(resumed.w))
+
+
+def test_run_with_restarts_still_quarantines_direct_corruption(tmp_path):
+    """The watchdog-level quarantine path survives for DIRECT restore
+    callers (explicit-step loads, non-segmented users): budget-free
+    quarantine, then success."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "step_5.msgpack")
+    with open(path, "wb") as f:
+        f.write(b"junk")
+    msgs = []
+
+    def run_once():
+        if os.path.exists(path):
+            raise ckpt.CorruptCheckpointError(path, "boom")
+        return "ok"
+
+    assert ckpt.run_with_restarts(run_once, max_restarts=1,
+                                  logger=msgs.append) == "ok"
+    assert os.path.exists(path + ".corrupt")
+    assert any("0/1 used" in m for m in msgs)
+
+    # max_restarts=0 still means "no recovery of any kind"
+    with open(path, "wb") as f:
+        f.write(b"junk")
+    with pytest.raises(ckpt.CorruptCheckpointError):
+        ckpt.run_with_restarts(run_once, max_restarts=0)
+
+
+# ---- durability: CRC32 footer + fsync + write retry (PR 3) ----
+
+
+def test_crc_footer_detects_torn_write(tmp_path):
+    """A flipped byte ANYWHERE in the payload — even one that still
+    msgpack-parses — is a CorruptCheckpointError, not a silent resume
+    from garbage."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    p = ckpt.save(d, {"w": np.arange(64, dtype=np.float32)}, step=1)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF  # well inside the payload
+    with open(p, "wb") as f:
+        f.write(raw)
+    with pytest.raises(ckpt.CorruptCheckpointError, match="CRC32") as ei:
+        ckpt.restore(d)
+    assert ei.value.path == p  # carried for the quarantine fallback
+    assert os.path.exists(p)   # detection does not quarantine by itself
+
+
+def test_crc_footer_roundtrip_and_legacy_footerless(tmp_path):
+    from flax import serialization
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    tree = {"w": np.arange(8, dtype=np.float32),
+            "step": np.int32(7)}
+    ckpt.save(d, tree, step=2)
+    got, step = ckpt.restore(d)
+    assert step == 2
+    np.testing.assert_array_equal(got["w"], tree["w"])
+
+    # a pre-PR-3 checkpoint has no footer: still restorable (its only
+    # guard is msgpack parseability, as before)
+    legacy = serialization.msgpack_serialize(
+        {"w": np.ones(3, np.float32)})
+    import os
+
+    with open(os.path.join(d, "step_9.msgpack"), "wb") as f:
+        f.write(legacy)
+    got9, step9 = ckpt.restore(d)
+    assert step9 == 9
+    np.testing.assert_array_equal(got9["w"], np.ones(3, np.float32))
+
+
+def test_save_retries_transient_oserror(tmp_path):
+    from tpu_distalg import faults
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    try:
+        faults.configure("seed=1;ckpt:write@0=oserror")
+        ckpt.save(str(tmp_path), {"w": np.zeros(4, np.float32)}, step=3)
+        assert faults.active().fired == [("ckpt:write", 0, "oserror")]
+    finally:
+        faults.configure(False)
+    got, step = ckpt.restore(str(tmp_path))
+    assert step == 3
+    np.testing.assert_array_equal(got["w"], np.zeros(4, np.float32))
+
+
+def test_injected_disk_corruption_is_caught_by_crc(tmp_path):
+    """The fault registry's ``corrupt`` at ckpt:write REALLY flips the
+    bytes that hit disk; the CRC (computed over the true payload)
+    catches it on restore."""
+    from tpu_distalg import faults
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    try:
+        faults.configure("seed=2;ckpt:write@0=corrupt")
+        ckpt.save(str(tmp_path), {"w": np.arange(32, dtype=np.float32)},
+                  step=1)
+    finally:
+        faults.configure(False)
+    with pytest.raises(ckpt.CorruptCheckpointError, match="CRC32"):
+        ckpt.restore(str(tmp_path))
+
+
+def test_quarantine_and_prune_tolerate_concurrent_races(tmp_path,
+                                                        monkeypatch):
+    """A concurrent restart's quarantine/prune racing ours: the file
+    being already gone is the DESIRED state, not an error."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    assert ckpt.quarantine(str(tmp_path / "never_existed.msgpack"))
+
+    # prune sees a listing with a file another process just removed
+    real_listdir = os.listdir
+    ghost = ["step_1.msgpack", "step_2.msgpack", "step_3.msgpack",
+             "step_4.msgpack"]
+    monkeypatch.setattr(os, "listdir",
+                        lambda d: ghost if str(d) == str(tmp_path)
+                        else real_listdir(d))
+    ckpt.prune(str(tmp_path), keep=1)  # must not raise
+
+
+# ---- preemption: SIGTERM mid-run, distinct rc, bitwise resume ----
+
+
+def test_sigterm_preempts_at_boundary_and_resume_is_bitwise(tmp_path):
+    """The acceptance scenario end-to-end in real subprocesses: SIGTERM
+    delivered mid-run exits with the distinct preemption rc having
+    saved a boundary checkpoint, and the resumed run's weights equal an
+    uninterrupted run's bitwise. The per-segment hang fault keeps the
+    run slow enough to signal deterministically — and doubles as proof
+    that an injected-hang run's trajectory is untouched."""
+    import glob
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from tpu_distalg import faults
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               TDA_TELEMETRY_DIR="", TDA_FAULT_PLAN="")
+
+    def cmd(d, plan=None):
+        c = [sys.executable, "-m", "tpu_distalg.cli", "lr",
+             "--n-slices", "2", "--n-iterations", "300",
+             "--checkpoint-dir", d, "--checkpoint-every", "20",
+             "--quiet"]
+        return c + (["--fault-plan", plan] if plan else [])
+
+    d_pre = str(tmp_path / "pre")
+    d_ref = str(tmp_path / "ref")
+
+    p = subprocess.Popen(
+        cmd(d_pre, "seed=1;segment:run@*=hang:0.15"), env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if len(glob.glob(os.path.join(d_pre, "step_*.msgpack"))) >= 2:
+            break
+        if p.poll() is not None:
+            break
+        time.sleep(0.02)
+    assert p.poll() is None, \
+        f"run finished before SIGTERM landed: {p.communicate()}"
+    p.send_signal(signal.SIGTERM)
+    out, err = p.communicate(timeout=180)
+    assert p.returncode == faults.PREEMPTED_RC, (p.returncode, out, err)
+    step_pre = ckpt.latest_step(d_pre)
+    assert step_pre is not None and 0 < step_pre < 300
+    assert step_pre % 20 == 0  # a BOUNDARY checkpoint, not a torn one
+
+    # resume (no fault plan: hangs only delayed the preempted run, so
+    # the trajectory is identical) and an uninterrupted reference
+    r = subprocess.run(cmd(d_pre), env=env, cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    r2 = subprocess.run(cmd(d_ref), env=env, cwd=repo,
+                        capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, (r2.returncode, r2.stdout, r2.stderr)
+
+    tree_a, step_a = ckpt.restore(d_pre)
+    tree_b, step_b = ckpt.restore(d_ref)
+    assert step_a == step_b == 300
+    for a, b in zip(tree_a["state"], tree_b["state"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(tree_a["accs"]),
+                                  np.asarray(tree_b["accs"]))
 
 
 def test_fused_train_segment_guard_catches_all_segment_lengths(data, tmp_path):

@@ -1,0 +1,506 @@
+"""Chaos suite: every recovery path is a TESTED code path.
+
+The acceptance grid this file exists for: each fault kind
+{transient-OSError, hang, byte-corruption, producer-death} at each of
+{ckpt:write, cache:write, data:gather, backend:init} must be survived
+by the EXISTING recovery machinery (supervised retry, restart+resume,
+quarantine fallback, prefetch liveness guard, backend degradation) and
+the recovered final state must be BITWISE-equal to an undisturbed run.
+Plus: fault plans are deterministic (same plan + seed replays the
+identical fire sequence, including in the telemetry JSONL), preemption
+exits at a checkpointed boundary with the distinct rc, and the
+``Prefetcher`` hang guard turns silent producer death into a prompt
+named error. Hangs injected here are tiny (≤0.3 s) — tier-1 stays
+fast; the long storm schedule is marked ``slow``.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu_distalg import faults
+from tpu_distalg.faults import chaos, preempt, registry
+from tpu_distalg.telemetry import events, supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Every test leaves the process-global registries disabled."""
+    yield
+    faults.configure(False)
+    preempt.reset()
+    events.configure(False)
+
+
+def _read_events(directory):
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("events-") and name.endswith(".jsonl"):
+            with open(os.path.join(directory, name)) as f:
+                out += [json.loads(ln) for ln in f if ln.strip()]
+    return out
+
+
+# ------------------------------------------------------------- fault plans
+
+def test_plan_parse_roundtrip():
+    spec = "seed=42;ckpt:write@1=oserror;segment:run@*=hang:0.1;" \
+           "data:gather@p0.25=kill"
+    plan = faults.FaultPlan.parse(spec)
+    assert plan.seed == 42
+    assert plan.rules[0] == faults.FaultRule("ckpt:write", "oserror",
+                                             hit=1)
+    assert plan.rules[1].hit is None and plan.rules[1].arg == 0.1
+    assert plan.rules[2].prob == 0.25
+    assert faults.FaultPlan.parse(plan.spec()) == plan
+
+
+def test_plan_parse_json_file(tmp_path):
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps({
+        "seed": 7,
+        "rules": [{"point": "cache:write", "kind": "corrupt", "hit": "*"},
+                  {"point": "backend:init", "kind": "hang", "hit": 2,
+                   "arg": 0.5}]}))
+    plan = faults.FaultPlan.parse(str(p))
+    assert plan.seed == 7
+    assert plan.rules[0].hit is None
+    assert plan.rules[1] == faults.FaultRule("backend:init", "hang",
+                                             hit=2, arg=0.5)
+
+
+def test_plan_rejects_unknown_point_and_kind():
+    with pytest.raises(ValueError, match="valid points"):
+        faults.FaultPlan.parse("nonsense:seam@0=oserror")
+    with pytest.raises(ValueError, match="valid kinds"):
+        faults.FaultPlan.parse("ckpt:write@0=explode")
+    with pytest.raises(ValueError, match="bad fault-plan term"):
+        faults.FaultPlan.parse("ckpt:write")
+    reg = faults.configure("seed=1")
+    with pytest.raises(ValueError, match="valid points"):
+        reg.inject("not:a:point")
+
+
+def test_registry_hit_schedule_fires_exactly_once():
+    reg = registry.FaultRegistry(
+        faults.FaultPlan.parse("ckpt:write@2=oserror"))
+    outcomes = []
+    for _ in range(5):
+        try:
+            reg.inject("ckpt:write", payload=b"x")
+            outcomes.append("ok")
+        except faults.InjectedOSError:
+            outcomes.append("fault")
+    assert outcomes == ["ok", "ok", "fault", "ok", "ok"]
+    assert reg.fired == [("ckpt:write", 2, "oserror")]
+    assert reg.hits("ckpt:write") == 5
+
+
+def test_registry_prob_schedule_is_seed_deterministic():
+    spec = "seed=11;data:gather@p0.5=oserror"
+
+    def fire_pattern(s):
+        reg = registry.FaultRegistry(faults.FaultPlan.parse(s))
+        pat = []
+        for _ in range(64):
+            try:
+                reg.inject("data:gather")
+                pat.append(0)
+            except faults.InjectedOSError:
+                pat.append(1)
+        return pat
+
+    a, b = fire_pattern(spec), fire_pattern(spec)
+    assert a == b                       # bitwise replay
+    assert 0 < sum(a) < 64              # actually probabilistic
+    assert fire_pattern("seed=12;data:gather@p0.5=oserror") != a
+
+
+def test_corruption_is_deterministic_and_detectable():
+    payload = bytes(range(256)) * 8
+    reg1 = registry.FaultRegistry(
+        faults.FaultPlan.parse("seed=3;ckpt:write@0=corrupt"))
+    reg2 = registry.FaultRegistry(
+        faults.FaultPlan.parse("seed=3;ckpt:write@0=corrupt"))
+    c1 = reg1.inject("ckpt:write", payload=payload)
+    c2 = reg2.inject("ckpt:write", payload=payload)
+    assert c1 == c2 and c1 != payload
+    # corruption with nothing to corrupt = detected-in-flight error
+    reg3 = registry.FaultRegistry(
+        faults.FaultPlan.parse("seed=3;data:gather@0=corrupt"))
+    with pytest.raises(faults.InjectedCorruptionError):
+        reg3.inject("data:gather")
+
+
+def test_hang_uses_injectable_sleep():
+    slept = []
+    reg = registry.FaultRegistry(
+        faults.FaultPlan.parse("segment:run@0=hang:2.5"),
+        sleep=slept.append)
+    reg.inject("segment:run")
+    assert slept == [2.5]
+
+
+def test_configure_env_fallback(monkeypatch):
+    monkeypatch.setenv(registry.ENV_PLAN, "seed=9;ckpt:read@0=oserror")
+    reg = faults.configure(None)
+    assert reg is not None and reg.plan.seed == 9
+    assert faults.configure(False) is None   # force-off ignores the env
+    assert not faults.enabled()
+
+
+def test_fault_fire_emits_telemetry(tmp_path):
+    events.configure(str(tmp_path))
+    faults.configure("seed=1;ckpt:write@0=oserror")
+    with pytest.raises(faults.InjectedOSError):
+        faults.inject("ckpt:write")
+    events.configure(False)
+    evts = _read_events(tmp_path)
+    fired = [e for e in evts if e["ev"] == "fault_injected"]
+    assert fired and fired[0]["point"] == "ckpt:write"
+    assert fired[0]["kind"] == "oserror" and fired[0]["hit"] == 0
+    counters = [e for e in evts if e["ev"] == "counters"][-1]["counters"]
+    assert counters["faults.injected"] == 1
+    assert counters["faults.oserror"] == 1
+
+
+# ------------------------------------------------------------- supervised()
+
+def test_supervised_retries_only_retry_on(tmp_path):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "v"
+
+    sleeps = []
+    assert supervisor.supervised(
+        flaky, phase="ckpt:write", retries=4, backoff=0.5,
+        backoff_cap=0.5, jitter=0.0, retry_on=(OSError,),
+        sleep=sleeps.append, log=lambda m: None) == "v"
+    assert calls["n"] == 3 and sleeps == [0.5, 0.5]
+
+    def config_error():
+        calls["n"] += 1
+        raise TypeError("deterministic")
+
+    calls["n"] = 0
+    with pytest.raises(TypeError):
+        supervisor.supervised(config_error, phase="x", retries=5,
+                              retry_on=(OSError,), sleep=lambda s: None,
+                              log=lambda m: None)
+    assert calls["n"] == 1  # not retried
+
+
+def test_supervised_exhaustion_reraises_last_real_error():
+    def dead():
+        raise OSError("still broken")
+
+    with pytest.raises(OSError, match="still broken"):
+        supervisor.supervised(dead, phase="cache:write", retries=2,
+                              backoff=0.0, sleep=lambda s: None,
+                              log=lambda m: None)
+
+
+def test_supervised_timeout_without_error_cls_is_timeout_error():
+    with pytest.raises(TimeoutError, match="deadline"):
+        supervisor.supervised(lambda: time.sleep(5.0), phase="x",
+                              timeout=0.05, retries=0,
+                              log=lambda m: None)
+
+
+# -------------------------------------------- the chaos acceptance grid
+#
+# {oserror, hang, corrupt, kill} x {ckpt:write, cache:write,
+# data:gather, backend:init}: survive via the existing recovery path,
+# recover bitwise.
+
+CKPT_WRITE_PLANS = {
+    # save()'s supervised retry absorbs it before anyone notices
+    "oserror": "seed=5;ckpt:write@1=oserror",
+    # a stall the write path just rides out
+    "hang": "seed=5;ckpt:write@1=hang:0.05",
+    # bytes corrupted ON DISK; a later crash forces a resume, which
+    # must CRC-detect the corruption and fall back a step in-process
+    "corrupt": "seed=5;ckpt:write@1=corrupt;segment:run@2=kill",
+    # the writer thread dies -> restartable error -> resume
+    "kill": "seed=5;ckpt:write@1=kill",
+}
+
+
+@pytest.mark.parametrize("kind", sorted(CKPT_WRITE_PLANS))
+def test_chaos_ckpt_write(kind, mesh8, tmp_path):
+    res = chaos.run_chaos("lr", mesh8, plan=CKPT_WRITE_PLANS[kind],
+                          workdir=str(tmp_path))
+    assert res.fired, "the plan never fired — the grid cell is untested"
+    assert res.equal, res.verdict()
+
+
+CACHE_WRITE_PLANS = {
+    "oserror": "seed=6;cache:write@0=oserror",
+    "hang": "seed=6;cache:write@0=hang:0.05",
+    # no payload at this seam -> detected-corruption OSError -> retried
+    "corrupt": "seed=6;cache:write@0=corrupt",
+    "kill": "seed=6;cache:write@0=kill",
+}
+
+
+@pytest.mark.parametrize("kind", sorted(CACHE_WRITE_PLANS))
+def test_chaos_cache_write(kind, tmp_path):
+    from tpu_distalg.data import cache as dcache
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    def build(path):
+        header = dcache.make_header(
+            layout="points_valid_f32", dtype=np.float32, shape=(64, 5),
+            geom={"seed": 1})
+
+        def write_bin(mm):
+            mm[:] = np.arange(64 * 5, dtype=np.float32).reshape(64, 5)
+
+        return dcache.build_cache(path, header=header,
+                                  write_bin=write_bin)
+
+    ref_mm, _ = build(str(tmp_path / "ref"))
+    faults.configure(CACHE_WRITE_PLANS[kind])
+    # kill is not an OSError: the in-place supervised retry passes on
+    # it and the job-level restart path rebuilds — both are "the
+    # existing recovery path" for their fault class
+    got_mm, _ = ckpt.run_with_restarts(
+        lambda: build(str(tmp_path / "chaos")), max_restarts=2,
+        logger=lambda m: None)
+    assert faults.active().fired
+    faults.configure(False)
+    np.testing.assert_array_equal(np.asarray(ref_mm), np.asarray(got_mm))
+
+
+DATA_GATHER_PLANS = {
+    # forwarded through the prefetch queue -> restart -> re-stream
+    "oserror": "seed=8;data:gather@1=oserror",
+    # producer stalls but stays alive: the consumer's bounded wait
+    # keeps waiting (liveness guard must NOT false-positive on slow)
+    "hang": "seed=8;data:gather@1=hang:0.3",
+    "corrupt": "seed=8;data:gather@1=corrupt",
+    # silent producer death -> ProducerDiedError -> restart
+    "kill": "seed=8;data:gather@1=kill",
+}
+
+
+@pytest.mark.parametrize("kind", sorted(DATA_GATHER_PLANS))
+def test_chaos_data_gather(kind, mesh4, tmp_path):
+    res = chaos.run_chaos("kmeans_stream", mesh4,
+                          plan=DATA_GATHER_PLANS[kind],
+                          workdir=str(tmp_path))
+    assert res.fired, "the plan never fired — the grid cell is untested"
+    assert res.equal, res.verdict()
+    if kind == "hang":
+        assert res.restarts_logged == 0  # waited, not killed
+
+
+BACKEND_INIT_PLANS = {
+    "oserror": ("seed=4;backend:init@0=oserror", None),
+    # hang past the supervisor deadline: single-flight wait-out
+    "hang": ("seed=4;backend:init@0=hang:0.3", 0.05),
+    "corrupt": ("seed=4;backend:init@0=corrupt", None),
+    "kill": ("seed=4;backend:init@0=kill", None),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(BACKEND_INIT_PLANS))
+def test_chaos_backend_init(kind):
+    plan, timeout = BACKEND_INIT_PLANS[kind]
+    devices = ["dev0", "dev1"]
+    ref = supervisor.init_backend(init_fn=lambda: list(devices),
+                                  log=lambda m: None)
+    faults.configure(plan)
+    got = supervisor.init_backend(
+        init_fn=lambda: list(devices), timeout=timeout, retries=10,
+        backoff=0.0, sleep=lambda s: None, log=lambda m: None)
+    assert faults.active().fired == [("backend:init", 0, kind)]
+    assert got == ref
+
+
+# ------------------------------------------------- replay determinism
+
+def test_same_plan_replays_identical_fault_sequence(mesh8, tmp_path):
+    """Acceptance: two chaos runs under the same plan+seed record the
+    SAME fault events in their telemetry JSONL."""
+    plan = "seed=13;ckpt:write@1=oserror;segment:run@2=kill"
+
+    def one(tag):
+        tdir = str(tmp_path / f"t_{tag}")
+        events.configure(tdir)
+        res = chaos.run_chaos("lr", mesh8, plan=plan,
+                              workdir=str(tmp_path / tag))
+        events.configure(False)
+        fired = [(e["point"], e["hit"], e["kind"])
+                 for e in _read_events(tdir)
+                 if e["ev"] == "fault_injected"]
+        return res, fired
+
+    res_a, fired_a = one("a")
+    res_b, fired_b = one("b")
+    assert res_a.equal and res_b.equal
+    assert fired_a == fired_b
+    assert fired_a == [("ckpt:write", 1, "oserror"),
+                       ("segment:run", 2, "kill")]
+
+
+@pytest.mark.slow
+def test_chaos_storm_probabilistic_schedule(mesh8, tmp_path):
+    """A longer probabilistic storm across several seams at once —
+    still bitwise, still deterministic in the seed."""
+    plan = ("seed=21;ckpt:write@p0.3=oserror;segment:run@p0.2=kill;"
+            "ckpt:read@p0.2=oserror")
+    res = chaos.run_chaos("ssgd", mesh8, plan=plan,
+                          workdir=str(tmp_path), n_iterations=150,
+                          checkpoint_every=25, max_restarts=8)
+    assert res.equal, res.verdict()
+
+
+# ------------------------------------------------- prefetch hang guard
+
+def test_prefetcher_silent_producer_death_raises_promptly():
+    from tpu_distalg.data import pipeline
+
+    def produce(i):
+        if i == 1:
+            raise faults.InjectedKill("thread shot")
+        return i
+
+    t0 = time.monotonic()
+    with pipeline.Prefetcher(produce, 4) as pf:
+        assert pf.get() == 0
+        with pytest.raises(pipeline.ProducerDiedError,
+                           match="without posting"):
+            pf.get()
+    assert time.monotonic() - t0 < 5.0  # prompt, not a wedge
+
+
+def test_prefetcher_slow_producer_is_waited_for():
+    from tpu_distalg.data import pipeline
+
+    def produce(i):
+        time.sleep(0.25)  # > one poll interval
+        return i * 10
+
+    with pipeline.Prefetcher(produce, 2) as pf:
+        assert pf.get() == 0
+        assert pf.get() == 10
+
+
+def test_prefetcher_forwarded_error_still_wins_over_guard():
+    from tpu_distalg.data import pipeline
+
+    def produce(i):
+        raise RuntimeError("organic failure")
+
+    with pipeline.Prefetcher(produce, 3) as pf:
+        with pytest.raises(RuntimeError, match="organic"):
+            pf.get()
+
+
+# -------------------------------------------------------- preemption
+
+def test_preempt_request_exits_at_boundary_and_resumes_bitwise(
+        mesh8, cancer_data, tmp_path):
+    """In-process version of the SIGTERM contract: a pending request
+    exits run_segmented at the NEXT segment boundary (checkpoint on
+    disk, Preempted raised), and the resumed run equals a straight
+    one bitwise."""
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=90)
+    d = str(tmp_path / "ck")
+    straight = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg)
+
+    preempt.request()
+    with pytest.raises(preempt.Preempted) as ei:
+        ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg,
+                   checkpoint_dir=d, checkpoint_every=30)
+    assert ei.value.step == 30 and ei.value.code == faults.PREEMPTED_RC
+    assert ckpt.latest_step(d) == 30  # the boundary checkpoint is real
+
+    preempt.reset()
+    resumed = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg,
+                         checkpoint_dir=d, checkpoint_every=30)
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(resumed.w))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(resumed.accs))
+
+
+def test_preempted_never_burns_restart_budget():
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    calls = {"n": 0}
+
+    def run_once():
+        calls["n"] += 1
+        raise preempt.Preempted(step=10)
+
+    with pytest.raises(preempt.Preempted):
+        ckpt.run_with_restarts(run_once, max_restarts=5,
+                               logger=lambda m: None)
+    assert calls["n"] == 1  # SystemExit family: never retried
+
+
+def test_preempt_on_final_segment_completes_normally(mesh8, cancer_data,
+                                                     tmp_path):
+    """A request that lands during the LAST segment must not turn a
+    finished run into a fake preemption."""
+    from tpu_distalg.models import ssgd
+
+    X_train, y_train, X_test, y_test = cancer_data
+    cfg = ssgd.SSGDConfig(n_iterations=30)
+    preempt.request()
+    res = ssgd.train(X_train, y_train, X_test, y_test, mesh8, cfg,
+                     checkpoint_dir=str(tmp_path / "ck"),
+                     checkpoint_every=30)
+    assert res.accs.shape == (30,)
+
+
+# ------------------------------------------------------- CLI + report
+
+def test_cli_chaos_subcommand(tmp_path, capsys):
+    from tpu_distalg import cli
+
+    rc = cli.main(["chaos", "--workload", "lr", "--n-slices", "8",
+                   "--n-iterations", "40", "--checkpoint-every", "20",
+                   "--workdir", str(tmp_path),
+                   "--fault-plan", "seed=1;ckpt:write@0=oserror"])
+    assert rc == 0
+    assert "[chaos] OK" in capsys.readouterr().out
+
+
+def test_cli_chaos_requires_a_plan(monkeypatch):
+    from tpu_distalg import cli
+
+    monkeypatch.delenv(registry.ENV_PLAN, raising=False)
+    with pytest.raises(SystemExit, match="fault schedule"):
+        cli.main(["chaos", "--workload", "lr"])
+
+
+def test_report_separates_injected_from_organic(tmp_path):
+    from tpu_distalg.telemetry import report
+
+    events.configure(str(tmp_path))
+    events.emit("fault_injected", point="ckpt:write", hit=1,
+                kind="oserror")
+    events.emit("restart", attempt=1, of=2, error="InjectedOSError: x")
+    events.emit("preempted", step=40, tag="lr")
+    events.configure(False)
+    s = report.summarize(report.load_events(str(tmp_path)))
+    assert s["faults_injected"] == [
+        {"point": "ckpt:write", "hit": 1, "kind": "oserror"}]
+    assert s["preemptions"] == [{"step": 40, "tag": "lr"}]
+    assert s["restarts"] == 1
+    txt = report.render(s)
+    assert "injected faults: 1" in txt and "preemptions: 1" in txt

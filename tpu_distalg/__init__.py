@@ -17,11 +17,16 @@ Layer map (SURVEY.md §7):
     models/    — workload entry points (replaces the reference's __main__ scripts)
     utils/     — PRNG, datasets, metrics, plotting, checkpointing
     telemetry/ — structured JSONL runtime events, heartbeat/stall detection,
-                 supervised backend init, `tda report` log summarization
+                 supervised execution (deadline/retry/backoff/degrade),
+                 `tda report` log summarization
+    faults/    — deterministic seeded fault injection at every I/O seam,
+                 graceful SIGTERM/SIGINT preemption, the `tda chaos`
+                 bitwise-recovery harness
 """
 
-from tpu_distalg import data, ops, parallel, telemetry, utils
+from tpu_distalg import data, faults, ops, parallel, telemetry, utils
 
 __version__ = "0.1.0"
 
-__all__ = ["data", "ops", "parallel", "telemetry", "utils", "__version__"]
+__all__ = ["data", "faults", "ops", "parallel", "telemetry", "utils",
+           "__version__"]

@@ -79,14 +79,25 @@ def _add_data_backend(p, block_rows: int):
 
 
 def _add_telemetry(p):
-    """Telemetry flag — on EVERY subcommand: structured JSONL runtime
-    events (marks, spans, heartbeats, stalls, restarts) for the run,
-    summarized by ``tda report DIR`` (tpu_distalg/telemetry/)."""
+    """Telemetry + chaos flags — on EVERY subcommand: structured JSONL
+    runtime events (marks, spans, heartbeats, stalls, restarts) for the
+    run, summarized by ``tda report DIR`` (tpu_distalg/telemetry/), and
+    the deterministic fault-injection plan (tpu_distalg/faults/)."""
     p.add_argument("--telemetry-dir", type=str, default=None,
                    metavar="DIR",
                    help="write structured JSONL runtime events here "
                         "($TDA_TELEMETRY_DIR is the default when "
                         "unset); summarize with 'tda report DIR'")
+    p.add_argument("--fault-plan", type=str, default=None,
+                   metavar="SPEC",
+                   help="deterministic fault-injection plan: inline "
+                        "'seed=N;point@hit=kind[:arg];...' or a JSON "
+                        "plan file ($TDA_FAULT_PLAN is the default; "
+                        "points: ckpt:write, ckpt:read, cache:write, "
+                        "data:gather, data:h2d, backend:init, "
+                        "segment:run; kinds: oserror, hang, corrupt, "
+                        "kill). The same plan+seed replays the same "
+                        "failure sequence bitwise — see 'tda chaos'")
 
 
 def _add_ckpt(p, every_default):
@@ -265,6 +276,26 @@ def main(argv=None):
                         "up to N times on a device crash")
     _add_telemetry(p)
 
+    p = sub.add_parser(
+        "chaos",
+        help="run a small workload twice — undisturbed, then under an "
+             "injected fault schedule with the full recovery stack "
+             "armed — and verify the recovered final state is bitwise-"
+             "equal (rc 1 on mismatch)")
+    p.add_argument("--workload", default="lr",
+                   choices=["lr", "ssgd", "kmeans", "als",
+                            "kmeans_stream"])
+    p.add_argument("--n-slices", type=int, default=0)
+    p.add_argument("--n-iterations", type=int, default=None,
+                   help="override the workload's small default")
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="restart budget for the chaos run")
+    p.add_argument("--workdir", type=str, default=None,
+                   help="checkpoint scratch directory (default: a "
+                        "fresh temp dir, removed on success)")
+    _add_telemetry(p)
+
     p = sub.add_parser("report",
                        help="summarize a telemetry event log: phase "
                             "durations, stalls, backend-init attempts, "
@@ -288,9 +319,23 @@ def main(argv=None):
             print(f"tda report: {e}", file=sys.stderr)
             return 2
 
-    from tpu_distalg import telemetry
+    from tpu_distalg import faults, telemetry
 
     telemetry.configure(getattr(args, "telemetry_dir", None))
+    if args.cmd != "chaos":
+        # the chaos harness owns the registry lifecycle itself (it runs
+        # an undisturbed reference first); everywhere else the plan is
+        # live for the whole run
+        faults.configure(getattr(args, "fault_plan", None))
+    if getattr(args, "checkpoint_dir", None):
+        # SIGTERM/SIGINT become a graceful "checkpoint at the next
+        # segment boundary, then exit PREEMPTED_RC" request
+        # (faults/preempt.py) — the spot-VM/eviction contract every
+        # production scheduler assumes. Only when a checkpoint dir
+        # exists to satisfy the request: a non-checkpointed run has no
+        # boundary to save at, and swallowing its SIGTERM/first-SIGINT
+        # would make it HARDER to stop, not more graceful.
+        faults.preempt.install()
 
     if args.emulate:
         from tpu_distalg.parallel.mesh import emulate_devices
@@ -330,6 +375,13 @@ def main(argv=None):
         with profiling.maybe_trace(args.profile):
             with telemetry.span(f"cli:{args.cmd}"):
                 return _dispatch(args, jax)
+    except faults.Preempted as e:
+        # the graceful exit: the boundary checkpoint is already on
+        # disk — re-running the same command resumes bitwise
+        print(f"[preempted] checkpoint saved at step {e.step}; "
+              f"re-run the same command to resume "
+              f"(rc={faults.PREEMPTED_RC})", file=sys.stderr)
+        return faults.PREEMPTED_RC
     finally:
         if hb is not None:
             hb.stop()
@@ -666,6 +718,47 @@ def _dispatch(args, jax):
                 max_restarts=args.max_restarts)
         for t, e in enumerate(res.rmse_history):
             print(f"iterations: {t}, rmse: {float(e):f}")
+
+    elif args.cmd == "chaos":
+        import os
+        import tempfile
+
+        from tpu_distalg import faults
+        from tpu_distalg.faults import chaos
+
+        spec = args.fault_plan or os.environ.get(faults.registry.ENV_PLAN)
+        if not spec:
+            raise SystemExit(
+                "tda chaos needs a fault schedule: pass --fault-plan "
+                "'seed=N;point@hit=kind[:arg];...' (or a JSON plan "
+                "file, or export $TDA_FAULT_PLAN)")
+        mesh = _mesh(args)
+        workdir = args.workdir
+        made_tmp = workdir is None
+        if made_tmp:
+            workdir = tempfile.mkdtemp(prefix="tda-chaos-")
+        res = None
+        try:
+            res = chaos.run_chaos(
+                args.workload, mesh, plan=spec, workdir=workdir,
+                n_iterations=args.n_iterations,
+                checkpoint_every=args.checkpoint_every,
+                max_restarts=args.max_restarts,
+                logger=lambda m: print(f"[chaos] {m}"))
+        finally:
+            if made_tmp:
+                if res is not None and res.equal:
+                    import shutil
+
+                    shutil.rmtree(workdir, ignore_errors=True)
+                else:
+                    # a mismatch (or a blown restart budget) is exactly
+                    # when the checkpoints + quarantined files matter —
+                    # keep the evidence
+                    print(f"[chaos] scratch kept for debugging: "
+                          f"{workdir}", file=sys.stderr)
+        print(res.verdict())
+        return 0 if res.equal else 1
 
     elif args.cmd == "mc":
         from tpu_distalg.models import monte_carlo as m

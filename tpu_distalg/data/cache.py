@@ -54,7 +54,14 @@ import uuid
 
 import numpy as np
 
+from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
+
+# transient-disk-fault retry schedule for a build attempt (the
+# ``cache:write`` injection point fires inside each attempt); a real
+# outage longer than this is the caller's run_with_restarts' job
+BUILD_RETRIES = 2
+BUILD_BACKOFF_SECONDS = 0.05
 
 FORMAT = "tda-packed-cache"
 FORMAT_VERSION = 2
@@ -221,8 +228,14 @@ def build_cache(path: str, *, header: dict, write_bin, aux=()):
     Content MUST be deterministic in the header: two concurrent
     builders both publish, the last rename wins, and either winner is
     byte-identical. The whole build runs inside a
-    ``data:cache_build`` telemetry span.
+    ``data:cache_build`` telemetry span. A transient ``OSError``
+    (including the ``cache:write`` injection point's) retries the whole
+    generate+publish attempt in place (:data:`BUILD_RETRIES` attempts —
+    determinism makes a re-run byte-identical, so retrying from scratch
+    is always safe).
     """
+    from tpu_distalg.telemetry.supervisor import supervised
+
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     sweep_stale_tmp(path)
@@ -234,21 +247,32 @@ def build_cache(path: str, *, header: dict, write_bin, aux=()):
     aux_tmps = [(aux_path(path, name), aux_path(path, name) + tmp_tag, fn)
                 for name, fn in aux]
     tmps = [bin_tmp, meta_tmp] + [t for _, t, _ in aux_tmps]
+
+    def build_once():
+        faults.inject("cache:write")
+        mm = np.memmap(bin_tmp, dtype=dtype, mode="w+", shape=shape)
+        write_bin(mm)
+        mm.flush()
+        del mm
+        for final, tmp, fn in aux_tmps:
+            fn(tmp)
+            os.replace(tmp, final)
+        os.replace(bin_tmp, bin_path(path))
+        with open(meta_tmp, "w") as f:
+            json.dump(header, f)
+        os.replace(meta_tmp, meta_path(path))
+
     try:
         with tevents.span("data:cache_build", path=path,
                           layout=header.get("layout"),
                           bytes=int(np.prod(shape)) * dtype.itemsize):
-            mm = np.memmap(bin_tmp, dtype=dtype, mode="w+", shape=shape)
-            write_bin(mm)
-            mm.flush()
-            del mm
-            for final, tmp, fn in aux_tmps:
-                fn(tmp)
-                os.replace(tmp, final)
-            os.replace(bin_tmp, bin_path(path))
-            with open(meta_tmp, "w") as f:
-                json.dump(header, f)
-            os.replace(meta_tmp, meta_path(path))
+            supervised(build_once, phase="cache:write",
+                       retries=BUILD_RETRIES,
+                       backoff=BUILD_BACKOFF_SECONDS,
+                       backoff_cap=BUILD_BACKOFF_SECONDS, jitter=0.0,
+                       retry_on=(OSError,),
+                       failure_counter="cache.write_failures",
+                       log=lambda m: None)
     finally:
         # a failed generation must not orphan multi-GB tmp bytes
         # (kill -9 still can — sweep_stale_tmp catches those next call)

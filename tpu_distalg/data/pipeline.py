@@ -20,7 +20,12 @@ Invariants the extraction preserves (they are the bitwise contract):
     re-raised in the consumer; on any exit the producer is halted and
     joined (``Prefetcher`` is a context manager, and
     :func:`stream_staged` is a generator whose ``finally`` closes it —
-    iterate under ``contextlib.closing`` when you may exit early).
+    iterate under ``contextlib.closing`` when you may exit early);
+  * a producer thread that DIES without posting anything (a bug, an
+    injected ``faults.InjectedKill``) cannot block the consumer
+    forever: :meth:`Prefetcher.get` waits in bounded intervals and
+    checks producer liveness between them, raising
+    :class:`ProducerDiedError` instead of hanging the run.
 """
 
 from __future__ import annotations
@@ -30,7 +35,15 @@ import threading
 
 import numpy as np
 
+from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
+
+
+class ProducerDiedError(RuntimeError):
+    """The prefetch producer thread exited without posting the item (or
+    an error) the consumer is waiting on — silent thread death, the one
+    failure a plain blocking ``Queue.get`` turns into an eternal hang.
+    A plain ``RuntimeError`` so ``run_with_restarts`` retries it."""
 
 
 class Prefetcher:
@@ -39,6 +52,11 @@ class Prefetcher:
     queue; :meth:`get` returns the next item or re-raises the
     producer's exception. Use as a context manager — ``__exit__`` halts
     and joins the thread whatever state the queue is in."""
+
+    # liveness-check cadence for get(): long enough to cost nothing on
+    # the healthy path, short enough that a dead producer is a prompt,
+    # named error instead of a wedged run
+    POLL_SECONDS = 0.1
 
     def __init__(self, produce, n_items: int,
                  name: str = "tda-data-prefetch"):
@@ -64,11 +82,43 @@ class Prefetcher:
             for i in range(self._n):
                 if not self._offer(self._produce(i)):
                     return
+        except faults.InjectedKill:
+            # die SILENTLY — no error posted. This is the chaos model
+            # of a producer killed mid-flight; the consumer's liveness
+            # guard in get() must turn it into ProducerDiedError.
+            return
         except BaseException as e:  # noqa: BLE001 — re-raised in get()
             self._offer(e)
 
     def get(self):
-        item = self._q.get()
+        """Next item, or re-raise the producer's forwarded exception.
+        Bounded-interval wait with a producer-liveness check: a dead
+        producer raises :class:`ProducerDiedError` instead of blocking
+        forever (a HUNG-but-alive producer is still waited on — that is
+        the heartbeat watchdog's jurisdiction, not this guard's)."""
+        while True:
+            try:
+                item = self._q.get(timeout=self.POLL_SECONDS)
+                break
+            except queue.Empty:
+                th = self._thread
+                if th is None or not th.is_alive():
+                    # one last non-blocking drain: the producer may have
+                    # posted its final item between our timeout and the
+                    # liveness check
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        tevents.counter("faults.producer_deaths_detected")
+                        what = ("was never started" if th is None
+                                else f"{th.name} died")
+                        raise ProducerDiedError(
+                            f"prefetch producer thread {what} without "
+                            f"posting an item or an error; the batch it "
+                            f"owed will never arrive — restart the "
+                            f"stream (run_with_restarts recovers this)"
+                        ) from None
         if isinstance(item, BaseException):
             raise item
         return item

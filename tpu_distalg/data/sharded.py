@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
 
 BACKENDS = ("resident", "virtual", "streamed")
@@ -209,6 +210,11 @@ class ShardedDataset:
                              "use stage()")
         bp = self.block_rows
         with tevents.span("data:gather", backend=self.backend):
+            # chaos seam: on the streamed path this runs on the
+            # prefetch producer thread, so an injected kill here dies
+            # silently and exercises the consumer's liveness guard;
+            # corrupt (no payload) models checksum-detected bad reads
+            faults.inject("data:gather")
             rows = (ids_step[:, :, None] * bp
                     + np.arange(bp)[None, None, :]).reshape(
                         self.n_shards, -1)
@@ -229,6 +235,7 @@ class ShardedDataset:
 
         with tevents.span("data:h2d", backend=self.backend,
                           bytes=int(gathered.nbytes)):
+            faults.inject("data:h2d")
             staged = jax.device_put(gathered, self.shard_spec)
             self._touch(staged)  # async; result dropped
         tevents.counter("data.h2d_batches")

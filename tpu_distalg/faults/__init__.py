@@ -1,0 +1,51 @@
+"""Deterministic fault injection & preemption tolerance.
+
+The subsystem that keeps every recovery path in this repo a TESTED code
+path instead of a claimed one: a seeded, replayable fault-injection
+registry wired at every I/O and supervision seam (:mod:`registry`), a
+cooperative SIGTERM/SIGINT preemption handler that checkpoints at the
+next segment boundary and exits with a distinct rc (:mod:`preempt`),
+and the chaos harness that runs real workloads under injected fault
+schedules and asserts bitwise-equal recovery (:mod:`chaos`,
+``tda chaos``).
+
+Import cost is stdlib-only (plus the stdlib-only telemetry events
+module) so checkpoint writers and cache builders in plain host
+processes run under chaos without a jax import.
+"""
+
+from tpu_distalg.faults import preempt, registry
+from tpu_distalg.faults.preempt import PREEMPTED_RC, Preempted
+from tpu_distalg.faults.registry import (
+    KINDS,
+    POINTS,
+    FaultPlan,
+    FaultRegistry,
+    FaultRule,
+    InjectedCorruptionError,
+    InjectedKill,
+    InjectedOSError,
+    active,
+    configure,
+    enabled,
+    inject,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultRegistry",
+    "FaultRule",
+    "InjectedCorruptionError",
+    "InjectedKill",
+    "InjectedOSError",
+    "KINDS",
+    "POINTS",
+    "PREEMPTED_RC",
+    "Preempted",
+    "active",
+    "configure",
+    "enabled",
+    "inject",
+    "preempt",
+    "registry",
+]

@@ -1,0 +1,210 @@
+"""The chaos harness — ``tda chaos``: prove recovery, don't claim it.
+
+Runs one small real workload TWICE: once undisturbed, once under a
+:class:`~tpu_distalg.faults.FaultPlan` with the full recovery stack
+armed (``run_with_restarts`` + a checkpoint directory), and asserts the
+recovered final state is BITWISE-equal to the undisturbed run. That
+single assertion is the whole point of the repo's recovery machinery:
+absolute-step PRNG keying makes segmented ≡ straight ≡ crashed-and-
+resumed, so any drift under chaos is a real bug, not noise.
+
+Workloads are deliberately tiny (seconds on the CPU mesh) — the value
+is the fault schedule, not the FLOPs:
+
+  ``lr``             full-batch logistic regression (checkpointed)
+  ``ssgd``           minibatch SGD (checkpointed; PRNG keyed on
+                     absolute step)
+  ``kmeans``         full-batch Lloyd (checkpointed)
+  ``als``            alternating least squares (checkpointed)
+  ``kmeans_stream``  minibatch k-means over a virtual-backend
+                     ShardedDataset — the prefetch pipeline under
+                     chaos (``data:gather`` / ``data:h2d`` faults;
+                     stateless, so a restart re-runs from step 0
+                     deterministically)
+
+Used three ways: the ``tda chaos`` CLI subcommand (rc 1 on any
+mismatch), ``tests/test_faults.py``'s acceptance grid, and ad-hoc
+reproduction of a production fault schedule (`--fault-plan` accepts the
+JSONL-recorded plan of a real incident).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_distalg import faults
+from tpu_distalg.telemetry import events as tevents
+
+WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream")
+
+# enough restarts to survive a multi-fault schedule without masking a
+# deterministic bug forever (a fault that keeps re-firing on @* rules
+# still exhausts this and fails loudly)
+DEFAULT_MAX_RESTARTS = 3
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    workload: str
+    plan_spec: str
+    equal: bool
+    mismatched: list[str]
+    fired: list[tuple[str, int, str]]
+    restarts_logged: int
+
+    def verdict(self) -> str:
+        fired = ", ".join(f"{p}#{h}={k}" for p, h, k in self.fired) or "-"
+        if self.equal:
+            return (f"[chaos] OK: {self.workload} recovered bitwise-"
+                    f"equal under {len(self.fired)} injected fault(s) "
+                    f"({fired}; {self.restarts_logged} restart(s))")
+        return (f"[chaos] MISMATCH: {self.workload} diverged in "
+                f"{', '.join(self.mismatched)} under injected faults "
+                f"({fired}) — a recovery path is broken")
+
+
+def _leaves(workload: str, res) -> dict[str, np.ndarray]:
+    """The bitwise-comparison surface per workload: every array a user
+    could consume from the result."""
+    if workload in ("lr", "ssgd"):
+        return {"w": np.asarray(res.w), "accs": np.asarray(res.accs)}
+    if workload in ("kmeans", "kmeans_stream"):
+        return {"centers": np.asarray(res.centers)}
+    if workload == "als":
+        return {"U": np.asarray(res.U), "V": np.asarray(res.V),
+                "rmse_history": np.asarray(res.rmse_history)}
+    raise ValueError(f"unknown chaos workload {workload!r}; choose from "
+                     f"{WORKLOADS}")
+
+
+def _make_runner(workload: str, mesh, n_iterations: int | None,
+                 checkpoint_every: int | None):
+    """Build ``run(checkpoint_dir) -> result`` for one workload, small
+    defaults. ``checkpoint_dir=None`` runs unsegmented (kmeans_stream —
+    stateless, restart-from-scratch recovery)."""
+    if workload == "lr":
+        from tpu_distalg.models import logistic_regression as m
+        from tpu_distalg.utils import datasets
+
+        data = datasets.breast_cancer_split()
+        cfg = m.LRConfig(n_iterations=n_iterations or 60)
+        every = checkpoint_every or 20
+
+        def run(ckpt_dir):
+            return m.train(*data, mesh, cfg, checkpoint_dir=ckpt_dir,
+                           checkpoint_every=every)
+        return run
+    if workload == "ssgd":
+        from tpu_distalg.models import ssgd as m
+        from tpu_distalg.utils import datasets
+
+        data = datasets.breast_cancer_split()
+        cfg = m.SSGDConfig(n_iterations=n_iterations or 90)
+        every = checkpoint_every or 30
+
+        def run(ckpt_dir):
+            return m.train(*data, mesh, cfg, checkpoint_dir=ckpt_dir,
+                           checkpoint_every=every)
+        return run
+    if workload == "kmeans":
+        from tpu_distalg.models import kmeans as m
+        from tpu_distalg.utils import datasets
+
+        pts = datasets.gaussian_mixture(4000, k=3, seed=1)
+        cfg = m.KMeansConfig(k=3, n_iterations=n_iterations or 9)
+        every = checkpoint_every or 3
+
+        def run(ckpt_dir):
+            return m.fit(pts, mesh, cfg, checkpoint_dir=ckpt_dir,
+                         checkpoint_every=every)
+        return run
+    if workload == "als":
+        from tpu_distalg.models import als as m
+
+        cfg = m.ALSConfig(n_iterations=n_iterations or 6)
+        every = checkpoint_every or 2
+
+        def run(ckpt_dir):
+            return m.fit(mesh, cfg, checkpoint_dir=ckpt_dir,
+                         checkpoint_every=every)
+        return run
+    if workload == "kmeans_stream":
+        from tpu_distalg.data import builders
+        from tpu_distalg.models import kmeans as m
+
+        ds, _ = builders.gaussian_points_dataset(
+            mesh, 4096, dim=8, k=3, seed=1, block_rows=256,
+            backend="virtual")
+        cfg = m.KMeansConfig(k=3)
+        steps = n_iterations or 8
+
+        def run(ckpt_dir):
+            del ckpt_dir  # stateless: recovery = deterministic re-run
+            return m.fit_minibatch(ds, cfg, n_steps=steps,
+                                   mini_batch_blocks=2)
+        return run
+    raise ValueError(f"unknown chaos workload {workload!r}; choose from "
+                     f"{WORKLOADS}")
+
+
+def run_chaos(workload: str, mesh, *, plan, workdir: str,
+              n_iterations: int | None = None,
+              checkpoint_every: int | None = None,
+              max_restarts: int = DEFAULT_MAX_RESTARTS,
+              logger=None) -> ChaosResult:
+    """The harness core: undisturbed run, chaos run, bitwise compare.
+
+    ``plan`` is a :class:`~tpu_distalg.faults.FaultPlan` or spec string.
+    Both runs use fresh checkpoint directories under ``workdir``; the
+    chaos run executes under ``run_with_restarts(max_restarts)``. The
+    process-global fault registry is left DISABLED on return (whatever
+    it was before — a chaos run is a self-contained experiment)."""
+    import os
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    if isinstance(plan, str):
+        plan = faults.FaultPlan.parse(plan)
+    log = logger or (lambda m: None)
+    runner = _make_runner(workload, mesh, n_iterations, checkpoint_every)
+    uses_ckpt = workload != "kmeans_stream"
+
+    def dirpath(name):
+        d = os.path.join(workdir, name)
+        return d if uses_ckpt else None
+
+    # undisturbed reference first — injection OFF whatever the env says
+    faults.configure(False)
+    tevents.mark("chaos:reference", emit_event=False)
+    ref = runner(dirpath("ref"))
+
+    # chaos run: fresh registry (invocation counters at zero) so the
+    # schedule replays identically on every invocation of the harness
+    reg = faults.configure(plan)
+    tevents.mark("chaos:faulted", emit_event=False)
+    restart_log: list[str] = []
+    try:
+        got = ckpt.run_with_restarts(
+            lambda: runner(dirpath("chaos")),
+            max_restarts=max_restarts,
+            logger=lambda m: (restart_log.append(m), log(m)))
+    finally:
+        fired = list(reg.fired)
+        faults.configure(False)
+
+    ref_leaves = _leaves(workload, ref)
+    got_leaves = _leaves(workload, got)
+    mismatched = [name for name, a in ref_leaves.items()
+                  if not np.array_equal(a, got_leaves[name])]
+    result = ChaosResult(
+        workload=workload, plan_spec=plan.spec(),
+        equal=not mismatched, mismatched=mismatched, fired=fired,
+        # the logger also receives "[quarantine] ..." lines — only
+        # count actual restart cycles in the verdict
+        restarts_logged=sum(1 for m in restart_log
+                            if m.startswith("[restart")))
+    tevents.emit("chaos_verdict", workload=workload, equal=result.equal,
+                 mismatched=mismatched, faults_fired=len(fired))
+    return result

@@ -28,6 +28,7 @@ from tpu_distalg.telemetry.heartbeat import Heartbeat, start_heartbeat
 from tpu_distalg.telemetry.supervisor import (
     BackendUnavailableError,
     init_backend,
+    supervised,
 )
 
 __all__ = [
@@ -47,5 +48,6 @@ __all__ = [
     "report",
     "span",
     "start_heartbeat",
+    "supervised",
     "supervisor",
 ]

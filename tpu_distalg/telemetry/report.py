@@ -61,6 +61,8 @@ def summarize(evts: list[dict]) -> dict:
     metrics: dict[str, dict] = {}
     gauges: dict[str, object] = {}
     counters: dict[str, int] = {}
+    faults_injected: list[dict] = []
+    preemptions: list[dict] = []
     restarts = quarantines = checkpoints = marks = heartbeats = 0
     last_heartbeat = None
     resolution = None
@@ -111,6 +113,17 @@ def summarize(evts: list[dict]) -> dict:
             resolution = "backend_unavailable"
         elif ev == "restart":
             restarts += 1
+        elif ev == "fault_injected":
+            # chaos bookkeeping: a run under an injected fault plan
+            # records every fire, so the report separates INJECTED
+            # failures from organic ones (the restart/stall/quarantine
+            # lines below count both)
+            faults_injected.append({"point": e.get("point"),
+                                    "hit": e.get("hit"),
+                                    "kind": e.get("kind")})
+        elif ev == "preempted":
+            preemptions.append({"step": e.get("step"),
+                                "tag": e.get("tag")})
         elif ev == "quarantine":
             quarantines += 1
         elif ev == "checkpoint_saved":
@@ -141,6 +154,8 @@ def summarize(evts: list[dict]) -> dict:
         "restarts": restarts,
         "quarantines": quarantines,
         "checkpoints_saved": checkpoints,
+        "faults_injected": faults_injected,
+        "preemptions": preemptions,
         "counters": counters,
         "gauges": gauges,
         "metrics": metrics,
@@ -186,6 +201,17 @@ def render(s: dict) -> str:
     lines.append(f"restarts: {s['restarts']}  "
                  f"quarantines: {s['quarantines']}  "
                  f"checkpoints saved: {s['checkpoints_saved']}")
+    if s.get("faults_injected"):
+        fired = ", ".join(f"{f['point']}#{f['hit']}={f['kind']}"
+                          for f in s["faults_injected"])
+        lines.append(
+            f"injected faults: {len(s['faults_injected'])} ({fired}) — "
+            f"failures above include these ON-PURPOSE ones")
+    if s.get("preemptions"):
+        steps = ", ".join(str(p["step"]) for p in s["preemptions"])
+        lines.append(
+            f"preemptions: {len(s['preemptions'])} (graceful boundary "
+            f"exit at step {steps}; resume is bitwise)")
     if s["counters"]:
         lines.append("counters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["counters"].items())))

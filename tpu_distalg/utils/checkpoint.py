@@ -7,28 +7,66 @@ msgpack file per step (flax serialization, atomic rename). Note ``save``
 gathers every leaf to this host via ``np.asarray`` — fine for the replicated
 model/optimizer state these workloads carry; use orbax directly for
 multi-host sharded checkpoints of device-resident datasets.
+
+Durability contract (chaos-tested, tests/test_faults.py):
+
+  * ``save`` appends a CRC32 footer, fsyncs the tmp file before the
+    atomic ``os.replace`` and the directory after it — a torn write
+    that still happens to msgpack-parse is DETECTED on restore as
+    :class:`CorruptCheckpointError` instead of silently resuming from
+    garbage, and a power cut cannot lose the rename;
+  * transient ``OSError`` during the write is retried in place via
+    :func:`telemetry.supervisor.supervised` before it becomes anyone
+    else's problem;
+  * ``run_segmented``'s resume quarantines a corrupt NEWEST checkpoint
+    and falls back to the next-older step in-process — recovery does
+    not require spending a ``run_with_restarts`` cycle;
+  * a preemption request (SIGTERM/SIGINT via ``faults.preempt``) exits
+    at the next segment boundary, AFTER that segment's checkpoint is
+    durably saved, with the distinct ``PREEMPTED_RC`` — the resumed run
+    is bitwise-identical to an uninterrupted one.
+
+Fault-injection points: ``ckpt:write`` (the payload bytes about to hit
+disk), ``ckpt:read`` (the bytes just read), ``segment:run`` (before
+each compiled segment) — see ``tpu_distalg/faults/registry.py``.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import struct
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
+from tpu_distalg import faults
+from tpu_distalg.faults import preempt
 from tpu_distalg.telemetry import events as tevents
 
 _STEP_RE = re.compile(r"^step_(\d+)\.msgpack$")
 
+# footer = magic + little-endian CRC32 of the payload bytes. The magic
+# starts with NUL so no legacy msgpack stream ends with it by accident
+# (msgpack never emits a bare trailing NUL run of this shape).
+_CRC_MAGIC = b"\x00TDACRC1"
+_CRC_FOOTER_LEN = len(_CRC_MAGIC) + 4
+
+# transient-disk-fault retry schedule for the write path: short and
+# fixed — a real outage longer than this is run_with_restarts' job
+SAVE_RETRIES = 2
+SAVE_BACKOFF_SECONDS = 0.05
+
 
 class CorruptCheckpointError(ValueError):
-    """A checkpoint file exists but will not deserialize — e.g. it was
-    half-written by the same crash the watchdog exists to survive (the
-    atomic rename in :func:`save` prevents this for clean process
-    deaths, but not for disk faults). Carries the offending ``path`` so
-    :func:`run_with_restarts` can quarantine it and resume from the
+    """A checkpoint file exists but will not deserialize or fails its
+    CRC — e.g. it was half-written by the same crash the watchdog
+    exists to survive (the atomic rename + fsync in :func:`save`
+    prevents this for clean process deaths, but not for disk faults).
+    Carries the offending ``path`` so the resume fallback (and
+    :func:`run_with_restarts`) can quarantine it and resume from the
     previous step instead of dying on a retryable condition."""
 
     def __init__(self, path: str, msg: str):
@@ -36,19 +74,75 @@ class CorruptCheckpointError(ValueError):
         self.path = path
 
 
+def _fsync_dir(directory: str) -> None:
+    """fsync the directory so the rename itself is durable (an atomic
+    replace whose dirent update is lost to a power cut resumes from the
+    WRONG step). Best-effort: some filesystems refuse directory fds."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save(ckpt_dir: str, tree: Any, step: int) -> str:
-    """Write ``tree`` at ``ckpt_dir/step_<step>.msgpack`` (atomic rename)."""
+    """Write ``tree`` at ``ckpt_dir/step_<step>.msgpack``: CRC32 footer,
+    fsync, atomic rename, directory fsync — with transient ``OSError``
+    retried (:data:`SAVE_RETRIES` attempts, fixed backoff)."""
     from flax import serialization
+
+    from tpu_distalg.telemetry.supervisor import supervised
 
     os.makedirs(ckpt_dir, exist_ok=True)
     host_tree = jax.tree.map(np.asarray, tree)
     payload = serialization.msgpack_serialize(host_tree)
+    # footer CRC is of the TRUE payload: an injected/real torn write
+    # corrupts the body after this point and the mismatch is caught on
+    # restore — the exact silent-resume-from-garbage hole being closed
+    footer = _CRC_MAGIC + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
     path = os.path.join(ckpt_dir, f"step_{step}.msgpack")
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
+
+    def write_once():
+        body = faults.inject("ckpt:write", payload=payload)
+        with open(tmp, "wb") as f:
+            f.write(body)
+            f.write(footer)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(ckpt_dir)
+
+    supervised(write_once, phase="ckpt:write", retries=SAVE_RETRIES,
+               backoff=SAVE_BACKOFF_SECONDS,
+               backoff_cap=SAVE_BACKOFF_SECONDS, jitter=0.0,
+               retry_on=(OSError,), failure_counter="ckpt.write_failures",
+               log=lambda m: None)
     return path
+
+
+def _strip_crc_footer(path: str, raw: bytes) -> bytes:
+    """Validate + strip the CRC footer; legacy footerless files pass
+    through (their only guard is msgpack parseability, as before)."""
+    if len(raw) >= _CRC_FOOTER_LEN and \
+            raw[-_CRC_FOOTER_LEN:-4] == _CRC_MAGIC:
+        body = raw[:-_CRC_FOOTER_LEN]
+        (want,) = struct.unpack("<I", raw[-4:])
+        got = zlib.crc32(body) & 0xFFFFFFFF
+        if got != want:
+            raise CorruptCheckpointError(
+                path,
+                f"corrupt checkpoint {path}: CRC32 mismatch "
+                f"(stored {want:#010x}, computed {got:#010x}) — the "
+                f"file was torn or bit-rotted after writing; delete or "
+                f"quarantine it to resume from an earlier step")
+        return body
+    return raw
 
 
 def latest_step(ckpt_dir: str) -> int | None:
@@ -63,7 +157,9 @@ def latest_step(ckpt_dir: str) -> int | None:
 
 
 def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
-    """Load (tree, step); ``step=None`` loads the newest checkpoint."""
+    """Load (tree, step); ``step=None`` loads the newest checkpoint.
+    The CRC footer (when present) is verified BEFORE parsing, so a torn
+    write that still happens to msgpack-parse cannot slip through."""
     from flax import serialization
 
     if step is None:
@@ -72,7 +168,11 @@ def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(ckpt_dir, f"step_{step}.msgpack")
     with open(path, "rb") as f:
-        payload = f.read()
+        raw = f.read()
+    # injected read-side corruption lands BEFORE the CRC check, so the
+    # detection path is the one being exercised
+    raw = faults.inject("ckpt:read", payload=raw)
+    payload = _strip_crc_footer(path, raw)
     try:
         tree = serialization.msgpack_restore(payload)
     except Exception as e:
@@ -82,6 +182,50 @@ def restore(ckpt_dir: str, step: int | None = None) -> tuple[Any, int]:
             f"it to resume from an earlier step"
         ) from e
     return tree, step
+
+
+def quarantine(path: str, *, logger=None) -> bool:
+    """Rename a corrupt checkpoint to ``<path>.corrupt`` so the next
+    resume sees the previous step. Tolerates the concurrent-process
+    race (another restart already quarantined or pruned it —
+    ``FileNotFoundError`` counts as done). Returns False only when the
+    rename fails for a reason that needs a human."""
+    try:
+        os.replace(path, path + ".corrupt")
+    except FileNotFoundError:
+        return True  # a concurrent process beat us to it
+    except OSError as os_err:
+        (logger or print)(
+            f"could not quarantine corrupt checkpoint {path} "
+            f"({os_err}); manual cleanup required")
+        return False
+    tevents.emit("quarantine", path=path)
+    tevents.counter("quarantines")
+    return True
+
+
+def _restore_newest_with_fallback(ckpt_dir: str, *, logger=None):
+    """The resume read path: try the newest checkpoint; a corrupt one is
+    quarantined IN-PROCESS and the next-older step is tried — recovery
+    from the crash-corrupts-newest-checkpoint scenario costs zero
+    restart budget. Returns ``(payload, step)`` or ``None`` when no
+    restorable checkpoint remains (fresh start)."""
+    while True:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None
+        try:
+            return restore(ckpt_dir, step)
+        except CorruptCheckpointError as e:
+            if not quarantine(e.path, logger=logger):
+                raise
+            (logger or print)(
+                f"[quarantine] corrupt checkpoint {e.path} -> .corrupt; "
+                f"falling back to the previous step in-process")
+        except FileNotFoundError:
+            # pruned/quarantined under us by a concurrent process
+            # between the listing and the open — re-list and retry
+            continue
 
 
 def run_segmented(
@@ -104,7 +248,9 @@ def run_segmented(
     saved and a non-finite guard trips with a clear error. An existing
     checkpoint resumes from its absolute step; because every builder
     threads the absolute step offset into its PRNG (``t0``), segmented
-    and straight-through runs are bitwise-identical.
+    and straight-through runs are bitwise-identical. A corrupt newest
+    checkpoint is quarantined and the next-older step resumes instead
+    (see :func:`_restore_newest_with_fallback`).
 
     ``make_seg_fn(seg_len)`` builds (and caches per distinct length) the
     compiled segment; ``run_seg(fn, state, t0)`` executes it and returns
@@ -120,6 +266,12 @@ def run_segmented(
     segments no-ops (carry their convergence signal in ``state``) so
     segmented and straight runs stay bitwise-identical. Returns
     ``(state, accs_concat, start_step)``.
+
+    Preemption: once ``faults.preempt`` has a pending request (SIGTERM/
+    SIGINT), the loop raises :class:`~tpu_distalg.faults.Preempted` at
+    the NEXT segment boundary — after that segment's checkpoint is
+    durably on disk — so the process exits with the distinct
+    ``PREEMPTED_RC`` and a re-run resumes bitwise-identically.
     """
     if checkpoint_every < 1:
         raise ValueError(
@@ -129,8 +281,9 @@ def run_segmented(
     start = 0
     accs_parts = []
     state = state0
-    if latest_step(checkpoint_dir) is not None:
-        payload, start = restore(checkpoint_dir)
+    restored = _restore_newest_with_fallback(checkpoint_dir)
+    if restored is not None:
+        payload, start = restored
         if start > n_iterations:
             raise ValueError(
                 f"checkpoint in {checkpoint_dir} is at step {start}, "
@@ -173,6 +326,7 @@ def run_segmented(
         # progress mark per segment: the telemetry heartbeat flags this
         # phase if a segment wedges (device hang) instead of staying mute
         tevents.mark(f"segment:{tag or 'train'}@{t}", emit_event=False)
+        faults.inject("segment:run")
         if seg not in seg_fns:
             seg_fns[seg] = make_seg_fn(seg)
         state, accs = run_seg(seg_fns[seg], state, t)
@@ -192,6 +346,14 @@ def run_segmented(
         prune(checkpoint_dir, keep=keep)
         tevents.emit("checkpoint_saved", step=t, tag=tag)
         tevents.counter("checkpoints_saved")
+        if preempt.requested() and t < n_iterations:
+            # boundary exit AFTER the durable save: the signal handler
+            # only sets a flag (async-signal-safe), so the telemetry
+            # record lands here instead
+            tevents.emit("preempted", step=t, tag=tag,
+                         signals=list(preempt.signals_seen()))
+            tevents.counter("preemptions")
+            raise preempt.Preempted(step=t)
     accs = (np.concatenate(accs_parts) if accs_parts
             else np.zeros((0,), np.float32))
     return state, accs, start
@@ -218,11 +380,15 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
     errors (``ValueError``/``TypeError``/``FileNotFoundError`` — e.g.
     an incompatible checkpoint directory) fail identically every time,
     so they are never retried; ``KeyboardInterrupt``/``SystemExit``
-    are never caught. The one retryable ``ValueError`` is
+    (which includes a graceful :class:`~tpu_distalg.faults.Preempted`
+    boundary exit — preemption must not burn the restart budget) are
+    never caught. The one retryable ``ValueError`` is
     :class:`CorruptCheckpointError`: the offending file is quarantined
     (renamed ``*.corrupt``) and the retry resumes from the previous
     step — a checkpoint corrupted by the very crash being survived must
-    not kill the watchdog.
+    not kill the watchdog. (``run_segmented``'s own resume already
+    falls back in-process; this path covers corruption detected by
+    DIRECT ``restore`` callers and explicit-step loads.)
     """
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
@@ -241,16 +407,8 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
             # recovery of any kind" and still raises.
             if max_restarts == 0:
                 raise
-            try:
-                os.replace(e.path, e.path + ".corrupt")
-            except OSError as os_err:
-                (logger or print)(
-                    f"could not quarantine corrupt checkpoint {e.path} "
-                    f"({os_err}); manual cleanup required"
-                )
-                raise e from os_err
-            tevents.emit("quarantine", path=e.path)
-            tevents.counter("quarantines")
+            if not quarantine(e.path, logger=logger):
+                raise
             (logger or print)(
                 f"[quarantine] corrupt checkpoint {e.path} -> .corrupt; "
                 f"resuming from the previous step (restart budget "
@@ -276,7 +434,9 @@ def run_with_restarts(run_once, max_restarts: int = 0, *, logger=None):
 
 
 def prune(ckpt_dir: str, keep: int = 3) -> None:
-    """Delete all but the newest ``keep`` checkpoints."""
+    """Delete all but the newest ``keep`` checkpoints. Tolerates a
+    concurrent restart's prune racing this one (``FileNotFoundError``
+    means the file is already gone — the desired state)."""
     if not os.path.isdir(ckpt_dir):
         return
     steps = sorted(
@@ -285,4 +445,7 @@ def prune(ckpt_dir: str, keep: int = 3) -> None:
         if (m := _STEP_RE.match(name))
     )
     for s in steps[:-keep] if keep else steps:
-        os.remove(os.path.join(ckpt_dir, f"step_{s}.msgpack"))
+        try:
+            os.remove(os.path.join(ckpt_dir, f"step_{s}.msgpack"))
+        except FileNotFoundError:
+            pass
