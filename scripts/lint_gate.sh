@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The one lint gate CI (and a pre-commit human) runs: domain rules
+# (TDA0xx), style (ruff, when installed — `tda lint` chains it over
+# the same files), and the README↔artifact reconciliation. Any failure
+# fails the gate; each tool prints its own findings.
+#
+#   scripts/lint_gate.sh            # gate the default surface
+#   scripts/lint_gate.sh --fix      # apply the mechanically-safe fixes
+#                                   # first (TDA021 daemon=, suppression
+#                                   # scaffolds), then gate
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+# 1. domain lint (chains ruff itself when installed)
+python -m tpu_distalg.cli lint tpu_distalg/ tests/ bench.py \
+    --baseline lint_baseline.json "$@" || rc=1
+
+# 2. README claims vs recorded bench artifacts
+python scripts/check_readme_claims.py || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint gate: FAILED" >&2
+else
+    echo "lint gate: OK"
+fi
+exit "$rc"

@@ -5,8 +5,6 @@ evaporated from the recorded tail)."""
 
 import json
 
-import pytest
-
 
 def test_backend_init_failure_emits_summary_and_fails(monkeypatch,
                                                       capsys):

@@ -5,7 +5,6 @@ mesh; the kernel path proper is benchmarked on hardware (bench.py)."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from tpu_distalg.models import pagerank

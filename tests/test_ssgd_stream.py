@@ -6,8 +6,6 @@ replacement for data that is NOT a function of the row id
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 from tpu_distalg.models import ssgd, ssgd_stream
 
 

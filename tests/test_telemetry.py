@@ -95,7 +95,7 @@ def test_concurrent_emitters_produce_wellformed_jsonl(sink_dir):
             events.emit("hammer", tid=tid, i=i)
             events.counter("hammered")
 
-    threads = [threading.Thread(target=hammer, args=(t,))
+    threads = [threading.Thread(target=hammer, args=(t,), daemon=False)
                for t in range(n_threads)]
     for th in threads:
         th.start()
@@ -477,9 +477,9 @@ def test_bench_emit_summary_concurrent_with_emit_is_wellformed(
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
-    threads = ([threading.Thread(target=emitter, args=(t,))
+    threads = ([threading.Thread(target=emitter, args=(t,), daemon=False)
                 for t in range(4)]
-               + [threading.Thread(target=summarizer)])
+               + [threading.Thread(target=summarizer, daemon=False)])
     for th in threads:
         th.start()
     for th in threads:

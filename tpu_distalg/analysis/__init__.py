@@ -1,0 +1,57 @@
+"""``tda lint`` — static analysis for the framework's own invariants.
+
+AST-based rules (``TDA0xx`` codes), each policing a guarantee another
+subsystem makes:
+
+==========  =========================================================
+TDA001      no wall clock / unseeded RNG in library code (bitwise
+            replay, PR 3)
+TDA002      no unordered (set/listdir/glob) iteration feeding
+            downstream order (collective + serialization order)
+TDA010      no Python side effects inside jit/shard_map/pallas_call
+            bodies (trace purity)
+TDA011      no host syncs inside step loops (``# tda: hot-loop`` or
+            step-named ``range`` loops)
+TDA020      thread-target writes to shared state hold a lock
+            (telemetry/prefetch thread conventions, PR 1)
+TDA021      every ``threading.Thread`` states ``daemon=`` explicitly
+TDA030      durable writes in ``tpu_distalg/`` route through a
+            ``faults.inject`` seam (chaos coverage, PR 3)
+TDA040      Pallas ``BlockSpec`` shapes tile in (8, 128) for f32
+TDA041      statically-sized resident blocks fit the VMEM budget
+==========  =========================================================
+
+Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
+is mandatory); grandfather existing debt with ``lint_baseline.json``.
+Run via ``tda lint [paths] [--format json] [--baseline FILE]
+[--select/--ignore CODES] [--fix]``. Stdlib + telemetry only — no jax.
+"""
+
+from tpu_distalg.analysis import baseline
+from tpu_distalg.analysis.concurrency import RULES as _CONCURRENCY
+from tpu_distalg.analysis.determinism import RULES as _DETERMINISM
+from tpu_distalg.analysis.engine import (
+    Rule,
+    Violation,
+    iter_python_files,
+    lint_file,
+    lint_source,
+)
+from tpu_distalg.analysis.pallas import RULES as _PALLAS
+from tpu_distalg.analysis.seams import RULES as _SEAMS
+from tpu_distalg.analysis.tracing import RULES as _TRACING
+
+#: every shipped rule, in code order
+RULES = tuple(sorted(
+    _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS,
+    key=lambda r: r.code))
+
+__all__ = [
+    "RULES",
+    "Rule",
+    "Violation",
+    "baseline",
+    "iter_python_files",
+    "lint_file",
+    "lint_source",
+]

@@ -1,0 +1,172 @@
+"""The ``tda lint`` front-end — arguments, output, ruff chaining.
+
+Exit codes: 0 clean (baselined findings included), 1 un-baselined
+violations or stale baseline entries (or a ruff failure when chained),
+2 usage errors. The whole run executes inside a telemetry ``lint`` span
+with per-code counters, so a CI run under ``--telemetry-dir`` leaves
+the same structured record every other subsystem does.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from tpu_distalg.analysis import baseline as blmod
+from tpu_distalg.analysis import engine, fixes
+from tpu_distalg.telemetry import events as tevents
+
+#: the repo's default lint surface (existing entries only, so the
+#: command works from any subdirectory too)
+DEFAULT_PATHS = ("tpu_distalg", "tests", "bench.py")
+
+
+def add_parser_args(p):
+    p.add_argument("paths", nargs="*", metavar="PATH",
+                   help="files/directories to lint (default: "
+                        "tpu_distalg/ tests/ bench.py, those that "
+                        "exist)")
+    p.add_argument("--format", default="text",
+                   choices=["text", "json"],
+                   help="text (one finding per line) or json (for CI)")
+    p.add_argument("--baseline", type=str, default=None,
+                   metavar="FILE",
+                   help="suppress findings recorded in FILE "
+                        "(default: ./lint_baseline.json when present); "
+                        "stale entries are an error")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline file from the current "
+                        "findings and exit 0")
+    p.add_argument("--select", type=str, default=None, metavar="CODES",
+                   help="comma-separated TDA codes to run (default "
+                        "all)")
+    p.add_argument("--ignore", type=str, default=None, metavar="CODES",
+                   help="comma-separated TDA codes to skip")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the mechanically-safe fixes (TDA021 "
+                        "daemon=False; scaffold reasonless "
+                        "suppressions) and re-lint")
+    p.add_argument("--no-ruff", action="store_true",
+                   help="skip the chained ruff run even when ruff is "
+                        "installed")
+
+
+def _codes(arg: str | None):
+    if arg is None:
+        return None
+    return tuple(c.strip().upper() for c in arg.split(",")
+                 if c.strip())
+
+
+def run_lint(args) -> int:
+    from tpu_distalg.analysis import RULES
+
+    paths = list(args.paths) or [p for p in DEFAULT_PATHS
+                                 if os.path.exists(p)]
+    if not paths:
+        print("tda lint: no paths given and none of "
+              f"{'/'.join(DEFAULT_PATHS)} exist here", file=sys.stderr)
+        return 2
+    try:
+        files = engine.iter_python_files(paths)
+        select, ignore = _codes(args.select), _codes(args.ignore)
+        with tevents.span("lint", files=len(files)):
+            rc = _run(args, files, RULES, select, ignore)
+        return rc
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tda lint: {e}", file=sys.stderr)
+        return 2
+
+
+def _run(args, files, rules, select, ignore) -> int:
+    violations = []
+    for path in files:
+        violations.extend(engine.lint_file(
+            path, rules, select=select, ignore=ignore))
+
+    if args.fix and violations:
+        by_file = collections.defaultdict(list)
+        for v in violations:
+            by_file[v.path].append(v)
+        n_fixed = sum(fixes.fix_file(p, vs)
+                      for p, vs in by_file.items())
+        if n_fixed:
+            print(f"tda lint: applied {n_fixed} fix(es); re-linting")
+            violations = []
+            for path in files:
+                violations.extend(engine.lint_file(
+                    path, rules, select=select, ignore=ignore))
+
+    tevents.counter("lint.files", len(files))
+    tevents.counter("lint.violations", len(violations))
+    for code, n in collections.Counter(
+            v.code for v in violations).items():
+        tevents.counter(f"lint.{code}", n)
+
+    bl_path = blmod.resolve(args.baseline)
+    if args.update_baseline:
+        target = args.baseline or "lint_baseline.json"
+        blmod.save(target, violations)
+        print(f"tda lint: baseline written: {target} "
+              f"({len(violations)} finding(s))")
+        return 0
+
+    baselined, stale = [], []
+    if bl_path is not None:
+        doc = blmod.load(bl_path)
+        violations, baselined, stale = blmod.apply(doc, violations)
+
+    ruff_rc, ruff_out = (0, "") if args.no_ruff else _chain_ruff(files)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": len(files),
+            "violations": [v.as_dict() for v in violations],
+            "baselined": len(baselined),
+            "stale_baseline": stale,
+            "ruff_rc": ruff_rc,
+            "ruff_output": ruff_out,
+        }, indent=1))
+    else:
+        for v in violations:
+            print(v.text())
+        if ruff_out:
+            print(ruff_out, end="")
+        for e in stale:
+            print(f"{e['path']}: stale baseline entry {e['code']} "
+                  f"({e['snippet']!r}) — the violation is gone; "
+                  f"regenerate with --update-baseline")
+        summary = (f"tda lint: {len(files)} file(s), "
+                   f"{len(violations)} violation(s)")
+        if baselined:
+            summary += f", {len(baselined)} baselined"
+        if stale:
+            summary += f", {len(stale)} stale baseline entr(ies)"
+        print(summary)
+
+    tevents.emit("lint_summary", files=len(files),
+                 violations=len(violations), baselined=len(baselined),
+                 stale=len(stale), ruff_rc=ruff_rc)
+    return 1 if (violations or stale or ruff_rc) else 0
+
+
+def _chain_ruff(files) -> tuple[int, str]:
+    """One lint entrypoint: when ruff is installed, run the pyproject-
+    configured pycodestyle/pyflakes/isort subset over the same files
+    and fold its exit code into ours. Output is CAPTURED (not
+    inherited) so ``--format json`` stays parseable JSON. Silently
+    skipped when absent — the container has no network and must not
+    fail on a missing luxury."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        return 0, ""
+    proc = subprocess.run([ruff, "check", *files],
+                          capture_output=True, text=True)
+    if proc.returncode:
+        print("tda lint: ruff reported findings (chained run)",
+              file=sys.stderr)
+    return (1 if proc.returncode else 0), proc.stdout

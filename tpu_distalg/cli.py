@@ -296,6 +296,20 @@ def main(argv=None):
                         "fresh temp dir, removed on success)")
     _add_telemetry(p)
 
+    p = sub.add_parser(
+        "lint",
+        help="static analysis for the framework's own invariants "
+             "(TDA0xx rules: determinism, trace purity, concurrency, "
+             "fault-seam coverage, Pallas hygiene); exits 1 on "
+             "un-baselined violations; chain-runs ruff when installed")
+    from tpu_distalg.analysis import cli as lint_cli
+
+    lint_cli.add_parser_args(p)
+    p.add_argument("--telemetry-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="record the lint run as telemetry events "
+                        "(a 'lint' span + per-rule counters)")
+
     p = sub.add_parser("report",
                        help="summarize a telemetry event log: phase "
                             "durations, stalls, backend-init attempts, "
@@ -306,6 +320,14 @@ def main(argv=None):
                    help="print the full summary as JSON (for CI)")
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        # pure source analysis — no backend, no mesh, no jax import
+        from tpu_distalg import telemetry
+        from tpu_distalg.analysis import cli as lint_cli
+
+        telemetry.configure(args.telemetry_dir)
+        return lint_cli.run_lint(args)
 
     if args.cmd == "report":
         # pure log analysis — no backend, no mesh, no jax import
@@ -716,7 +738,12 @@ def _dispatch(args, jax):
                               checkpoint_dir=args.checkpoint_dir,
                               checkpoint_every=args.checkpoint_every),
                 max_restarts=args.max_restarts)
-        for t, e in enumerate(res.rmse_history):
+        import numpy as np
+
+        # ONE device fetch for the whole history: float(e) per element
+        # is a D2H round-trip per line (the per-step-host-sync shape
+        # TDA011 polices); values print bitwise-identically
+        for t, e in enumerate(np.asarray(res.rmse_history)):
             print(f"iterations: {t}, rmse: {float(e):f}")
 
     elif args.cmd == "chaos":

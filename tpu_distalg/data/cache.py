@@ -202,9 +202,13 @@ def sweep_stale_tmp(path: str) -> None:
     would match a sibling cache sharing the prefix (``/data/cache`` vs
     ``/data/cache_big``) and yank its live tmp files. Age-gated so a
     concurrent live generator (minutes old) is never swept."""
+    # tda: ignore[TDA001] -- compared against file MTIMES (wall-clock
+    # domain by definition); never feeds a replayed value
     now = time.time()
     for pat in (bin_path(path) + ".tmp.*", meta_path(path) + ".tmp.*",
                 path + ".*.tmp.*"):
+        # tda: ignore[TDA002] -- unlink order is irrelevant: each
+        # orphan is removed independently, nothing downstream sees it
         for stale in glob.glob(pat):
             try:
                 if now - os.path.getmtime(stale) > STALE_TMP_SECONDS:

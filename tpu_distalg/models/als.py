@@ -341,6 +341,9 @@ def fit_streamed(dataset, config: ALSConfig | None = None, *,
                 C, UtU = C + C_inc, UtU + UtU_inc
                 us.append(U_b)
                 if serialize:
+                    # tda: ignore[TDA011] -- deliberate: on host
+                    # (CPU-mesh) backends this bounds the stream's
+                    # in-flight blocks; never taken on TPU
                     jax.block_until_ready(UtU)
         V = v_update_fn(UtU, C)
         want_rmse = (rmse_every and (sweep + 1) % rmse_every == 0) or \
@@ -351,6 +354,8 @@ def fit_streamed(dataset, config: ALSConfig | None = None, *,
                 for b, staged in enumerate(batches):
                     acc = acc + rmse_fn(staged, us[b], V)
                     if serialize:
+                        # tda: ignore[TDA011] -- deliberate: see the
+                        # solve loop above (host-backend stream bound)
                         jax.block_until_ready(acc)
             errs.append(jnp.sqrt(acc / denom))
     U = jnp.stack(us, axis=1).reshape(dataset.n2, k)

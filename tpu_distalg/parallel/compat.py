@@ -7,7 +7,6 @@ first and only falls back when it is absent.
 
 from __future__ import annotations
 
-import jax
 from jax import lax
 
 try:

@@ -9,13 +9,10 @@ collectives. ``replica_index`` is the analogue of the partition index that
 
 from __future__ import annotations
 
-import functools
-
-import jax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from tpu_distalg.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from tpu_distalg.parallel.mesh import DATA_AXIS
 
 
 def replica_index(axis_name: str = DATA_AXIS):

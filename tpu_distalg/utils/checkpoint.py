@@ -191,6 +191,10 @@ def quarantine(path: str, *, logger=None) -> bool:
     ``FileNotFoundError`` counts as done). Returns False only when the
     rename fails for a reason that needs a human."""
     try:
+        # tda: ignore[TDA030] -- recovery rename of an ALREADY-corrupt
+        # file, not a durable publish: a failure here is caught below
+        # and reported, and injecting at it would shift the ckpt:write
+        # hit counts every recorded chaos plan replays against
         os.replace(path, path + ".corrupt")
     except FileNotFoundError:
         return True  # a concurrent process beat us to it

@@ -291,6 +291,10 @@ def streamed_packed_cache(path: str, n_rows: int, n_features: int, *,
                          bits_t >> np.uint16(15))
         # a FILE handle: np.savez on a path appends '.npz', which would
         # break the engine's tmp→final rename
+        # tda: ignore[TDA030] -- aux writer invoked INSIDE
+        # cache.build_cache's cache:write seam (tmp→rename publish and
+        # injection both happen there); single-file analysis cannot
+        # see the callback edge
         with open(tmp_path, "wb") as f:
             np.savez(f, X=X_test, y=y_test.astype(np.float32),
                      w_true=w_true)
