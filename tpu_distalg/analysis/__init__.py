@@ -19,6 +19,9 @@ TDA030      durable writes in ``tpu_distalg/`` route through a
             ``faults.inject`` seam (chaos coverage, PR 3)
 TDA040      Pallas ``BlockSpec`` shapes tile in (8, 128) for f32
 TDA041      statically-sized resident blocks fit the VMEM budget
+TDA050      no raw ``lax.psum``-family collectives in
+            ``tpu_distalg/models/`` — gradient traffic stays behind
+            the instrumented comms layer (``parallel/comms.py``, PR 5)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
@@ -28,6 +31,7 @@ Run via ``tda lint [paths] [--format json] [--baseline FILE]
 """
 
 from tpu_distalg.analysis import baseline
+from tpu_distalg.analysis.comms import RULES as _COMMS
 from tpu_distalg.analysis.concurrency import RULES as _CONCURRENCY
 from tpu_distalg.analysis.determinism import RULES as _DETERMINISM
 from tpu_distalg.analysis.engine import (
@@ -43,7 +47,7 @@ from tpu_distalg.analysis.tracing import RULES as _TRACING
 
 #: every shipped rule, in code order
 RULES = tuple(sorted(
-    _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS,
+    _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS,
     key=lambda r: r.code))
 
 __all__ = [

@@ -1,0 +1,61 @@
+"""Comms-layer coverage — raw collectives in model code (TDA050).
+
+PR 5 built ``tpu_distalg/parallel/comms.py`` as the single instrumented
+choke point for cross-shard gradient/parameter traffic: every sync
+routes through a :class:`CommSpec`-selected schedule and is accounted
+in the ``comm.bytes_wire``/``bytes_logical``/``rounds`` telemetry
+counters. A raw ``lax.psum`` added to a model afterwards is traffic the
+knob cannot re-schedule and the counters never see — the byte
+accounting rots silently as models grow. This rule keeps the choke
+point exhaustive: model code calls the comms layer (``comms.psum`` /
+``comms.pmean`` / a ``CommSync`` / the ``collectives`` tree wrappers),
+never ``lax.psum``-family ops directly.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_distalg.analysis.engine import Rule, call_name
+
+#: the raw collective-reduction ops being policed (ppermute/all_gather
+#: pipelines are algorithm structure, not gradient sync — the ring
+#: kernels in parallel/ own those)
+_RAW_OPS = ("psum", "pmean", "psum_scatter", "pmax", "pmin")
+
+#: call roots that mean "the raw jax op" rather than a blessed wrapper
+_RAW_ROOTS = ("lax", "jax")
+
+
+class RawCollectiveInModels(Rule):
+    code = "TDA050"
+    name = "raw cross-shard collective outside the comms layer"
+    invariant = ("every cross-shard reduction in tpu_distalg/models/ "
+                 "routes through parallel/comms (comms.psum, a "
+                 "CommSync schedule) or the collectives tree wrappers, "
+                 "so all gradient/parameter traffic stays behind the "
+                 "one instrumented, --comm-schedulable choke point")
+
+    def applies(self, ctx):
+        return "tpu_distalg/models/" in ctx.path
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or "." not in name:
+                continue
+            parts = name.split(".")
+            if parts[-1] in _RAW_OPS and parts[0] in _RAW_ROOTS:
+                yield self.violation(
+                    ctx, node,
+                    f"raw {name}() in model code — route the "
+                    f"reduction through tpu_distalg.parallel.comms "
+                    f"(comms.{parts[-1]} for a verbatim psum, or a "
+                    f"CommSync for schedulable gradient sync) so the "
+                    f"--comm knob and the comm.bytes_wire accounting "
+                    f"cover it")
+
+
+RULES = (RawCollectiveInModels(),)

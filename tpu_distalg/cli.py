@@ -34,6 +34,20 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
     p.add_argument("--n-iterations", type=int, default=n_iterations)
     if eta is not None:
         p.add_argument("--eta", type=float, default=eta)
+        # the gradient/parameter sync schedule (parallel/comms.py) —
+        # SGD-family trainers only (the others have no per-round model
+        # sync to re-schedule)
+        p.add_argument(
+            "--comm", default="dense", metavar="SCHED",
+            help="cross-shard sync schedule: dense (bitwise the "
+                 "classic psum — default), bucketed[:elems] "
+                 "(ppermute-chunk ring, overlapped buckets), "
+                 "hier[:groups] (reduce-scatter intra-group / ring "
+                 "across groups / all-gather), bf16, int8[:seed] "
+                 "(seeded stochastic rounding), topk[:frac] "
+                 "(sparsified + error feedback). Emits "
+                 "comm.bytes_wire/bytes_logical/rounds telemetry "
+                 "counters per run")
     if frac is not None:
         p.add_argument("--mini-batch-fraction", type=float, default=frac)
         # TPU perf knobs (see ssgd.SSGDConfig.sampler for semantics);
@@ -422,7 +436,8 @@ def _dispatch(args, jax):
             def run_once():
                 return m.train(
                     *data, mesh, m.LRConfig(
-                        n_iterations=args.n_iterations, eta=args.eta),
+                        n_iterations=args.n_iterations, eta=args.eta,
+                        comm=args.comm),
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every)
         elif args.cmd == "ssgd" and args.stream_cache is not None:
@@ -434,6 +449,11 @@ def _dispatch(args, jax):
                 raise SystemExit(
                     "--mega-steps applies to sampler=fused_train only; "
                     "the streamed path runs one kernel per step")
+            if args.comm != "dense":
+                raise SystemExit(
+                    "--comm applies to the in-memory trainers; the "
+                    "streamed trainer (--stream-cache) stages blocks "
+                    "host->device per step and syncs dense")
             n_shards = int(mesh.shape["data"])
             X2, meta, (X_te, y_te) = datasets.streamed_packed_cache(
                 args.stream_cache, n_rows=args.stream_rows,
@@ -464,7 +484,8 @@ def _dispatch(args, jax):
                 sampler=args.sampler, x_dtype=args.x_dtype,
                 gather_block_rows=args.gather_block_rows,
                 fused_pack=args.fused_pack,
-                shuffle_seed=args.shuffle_seed)
+                shuffle_seed=args.shuffle_seed,
+                comm=args.comm)
             if args.sampler != "fused_train" and \
                     args.mega_steps is not None:
                 raise SystemExit(
@@ -538,7 +559,8 @@ def _dispatch(args, jax):
                         sampler=args.sampler, x_dtype=args.x_dtype,
                         gather_block_rows=args.gather_block_rows,
                         fused_pack=args.fused_pack,
-                        shuffle_seed=args.shuffle_seed),
+                        shuffle_seed=args.shuffle_seed,
+                        comm=args.comm),
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every)
         from tpu_distalg.utils import checkpoint as ckpt

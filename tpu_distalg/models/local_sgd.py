@@ -75,6 +75,12 @@ class LocalSGDConfig:
     fused_pack: int = 16
     gather_block_rows: int = 1024
     shuffle_seed: int | None = None
+    # round-combine sync schedule (parallel/comms.py): 'dense' (bitwise
+    # the pre-comms pmean — the default), 'bucketed', 'hier', 'bf16',
+    # 'int8', 'topk[:frac]' (error-feedback residuals in the scan
+    # state). The ONE collective of this family is the round-end model
+    # average, so every sampler (megakernel included) composes with it.
+    comm: str = "dense"
 
 
 @dataclasses.dataclass
@@ -88,13 +94,17 @@ class TrainResult:
         return float(self.accs[-1])
 
 
-def _make_local_rounds(config: LocalSGDConfig):
+def _make_local_rounds(config: LocalSGDConfig, sync=None):
     """shard_map body: resync (maybe), run L local steps on the local
     shard, then pmean the round's model average across replicas — the
     ``treeAggregate``/n combine (``ma.py:104-106``) as ONE collective
-    over the data axis, so the center update needs no gather."""
+    over the data axis, so the center update needs no gather.
 
-    def local_rounds(X, y, masks, ws_local, w):
+    With ``sync`` (a ``comms.CommSync``) the round-end average runs the
+    comm schedule instead of the raw pmean, and the body threads the
+    flat error-feedback residual ``res`` + absolute round id ``t``."""
+
+    def local_steps(X, y, masks, ws_local, w):
         # X (rows, D) local block; masks (L, rows); ws_local (1, D); w (D,)
         w_l = w if config.resync else ws_local[0]
 
@@ -109,14 +119,37 @@ def _make_local_rounds(config: LocalSGDConfig):
             return w_l, None
 
         w_l, _ = jax.lax.scan(local_step, w_l, masks)
-        return w_l[None, :], tree_allreduce_mean(w_l)
+        return w_l
 
-    return local_rounds
+    if sync is None:
+        def local_rounds(X, y, masks, ws_local, w):
+            w_l = local_steps(X, y, masks, ws_local, w)
+            return w_l[None, :], tree_allreduce_mean(w_l)
+
+        return local_rounds
+
+    def local_rounds_comm(X, y, masks, ws_local, w, t, res):
+        w_l = local_steps(X, y, masks, ws_local, w)
+        w_avg, res = sync.reduce_mean(w_l, res, t)
+        return w_l[None, :], w_avg, res
+
+    return local_rounds_comm
 
 
 def _derive_beta(config: LocalSGDConfig, n_replicas: int) -> float:
     return (config.beta if config.beta is not None
             else n_replicas * config.elastic_alpha)  # easgd.py:25
+
+
+def _comm_sync(mesh, config: LocalSGDConfig, d: int):
+    """The round combine's CommSync: ONE (D,) leaf — the per-replica
+    model being averaged (cf. ssgd's (grad, count) pair)."""
+    import jax
+
+    from tpu_distalg.parallel import comms
+
+    return comms.make_sync(
+        config.comm, mesh, jax.ShapeDtypeStruct((d,), jnp.float32))
 
 
 def _make_combine(config: LocalSGDConfig, beta: float):
@@ -138,24 +171,53 @@ def _make_combine(config: LocalSGDConfig, beta: float):
     return combine
 
 
-def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
+def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int,
+                  *, d: int | None = None):
+    """Build the jitted round scan. With ``config.comm != 'dense'``
+    pass ``d`` (model width); the returned fn is then called as
+    ``fn(X, y, valid, X_test, y_test, w0, ws0, delta0, res0, t0=0)`` →
+    ``(w, ws, delta, res, accs)``."""
     n_replicas = mesh.shape[DATA_AXIS]
     beta = _derive_beta(config, n_replicas)
     L = config.n_local_iterations
     key = prng.root_key(config.seed)
 
-    local_fn = data_parallel(
-        _make_local_rounds(config),
-        mesh,
-        in_specs=(
-            P("data", None),   # X rows
-            P("data"),         # y
-            P(None, "data"),   # masks (L, rows)
-            P("data", None),   # per-replica models (R, D) → (1, D) local
-            P(),               # center w
-        ),
-        out_specs=(P("data", None), P()),
-    )
+    sync = None
+    if config.comm != "dense":
+        if d is None:
+            raise ValueError(
+                f"comm={config.comm!r} needs the model width: call "
+                "make_train_fn(mesh, config, n_padded, d=D) "
+                "(local_sgd.train does this for you)"
+            )
+        sync = _comm_sync(mesh, config, d)
+        local_fn = data_parallel(
+            _make_local_rounds(config, sync),
+            mesh,
+            in_specs=(
+                P("data", None),   # X rows
+                P("data"),         # y
+                P(None, "data"),   # masks (L, rows)
+                P("data", None),   # per-replica models (R, D)
+                P(),               # center w
+                P(),               # absolute round id
+                P("data", None),   # error-feedback residual (R, E)
+            ),
+            out_specs=(P("data", None), P(), P("data", None)),
+        )
+    else:
+        local_fn = data_parallel(
+            _make_local_rounds(config),
+            mesh,
+            in_specs=(
+                P("data", None),   # X rows
+                P("data"),         # y
+                P(None, "data"),   # masks (L, rows)
+                P("data", None),   # per-replica models (R, D) → (1, D) local
+                P(),               # center w
+            ),
+            out_specs=(P("data", None), P()),
+        )
 
     def round_masks(valid, t):
         if config.resample_per_local_step:
@@ -175,6 +237,29 @@ def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int):
         return jnp.broadcast_to(mask, (L, n_padded))
 
     combine = _make_combine(config, beta)
+
+    if sync is not None:
+        def train(X, y, valid, X_test, y_test, w0, ws0, delta0, res0,
+                  t0=0):
+            def round_step(carry, t):
+                w, ws, delta, res = carry
+                masks = round_masks(valid, t)
+                ws, w_avg, res = local_fn(X, y, masks, ws, w, t, res)
+                w, delta = combine(w, w_avg, delta)
+                acc = (
+                    metrics.binary_accuracy(X_test @ w, y_test)
+                    if config.eval_test
+                    else jnp.float32(0)
+                )
+                return (w, ws, delta, res), acc
+
+            (w, ws, delta, res), accs = jax.lax.scan(
+                round_step, (w0, ws0, delta0, res0),
+                jnp.arange(config.n_iterations) + t0,
+            )
+            return w, ws, delta, res, accs
+
+        return jax.jit(train)
 
     def train(X, y, valid, X_test, y_test, w0, ws0, delta0, t0=0):
         def round_step(carry, t):
@@ -226,6 +311,8 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
     L = config.n_local_iterations
     beta = _derive_beta(config, n_replicas=n_shards)
     key = prng.root_key(config.seed)
+    sync = (_comm_sync(mesh, config, d_t)
+            if config.comm != "dense" else None)
     kern = functools.partial(
         pallas_kernels.fused_grad_sum_gathered,
         pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
@@ -267,7 +354,7 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
             interpret=not on_tpu,
         )
 
-        def local_rounds(X2, idx_round, ws_local, w):
+        def _local_models(X2, idx_round, ws_local, w):
             # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's
             # draws. The whole L-step local loop is ONE megakernel
             # launch: weights live in VMEM, the SGD update and the
@@ -280,10 +367,9 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
                 X2, jnp.tile(w_l, (pk,))[:, None], idx_round[:, 0, :],
                 center_tile=jnp.tile(w, (pk,))[:, None],
             )
-            w_l = wt[:d_t, 0]
-            return w_l[None, :], tree_allreduce_mean(w_l)
+            return wt[:d_t, 0]
     else:
-        def local_rounds(X2, idx_round, ws_local, w):
+        def _local_models(X2, idx_round, ws_local, w):
             # X2 (n2_local, P·D); idx_round (L, 1, ns) — this shard's
             # draws
             w_l = w if config.resync else ws_local[0]
@@ -299,20 +385,69 @@ def make_train_fn_fused(mesh: Mesh, config: LocalSGDConfig, meta: dict):
                 return w_l, None
 
             w_l, _ = jax.lax.scan(local_step, w_l, idx_round)
+            return w_l
+
+    if sync is not None:
+        def local_rounds(X2, idx_round, ws_local, w, t, res):
+            w_l = _local_models(X2, idx_round, ws_local, w)
+            # the one collective of this family: the round-end average,
+            # under the comm schedule with the residual threaded
+            w_avg, res = sync.reduce_mean(w_l, res, t)
+            return w_l[None, :], w_avg, res
+
+        local_fn = data_parallel(
+            local_rounds, mesh,
+            in_specs=(
+                P("data", None),          # packed rows
+                P(None, "data", None),    # (L, S, ns) draws → (L, 1, ns)
+                P("data", None),          # per-replica models
+                P(),                      # center w
+                P(),                      # absolute round id
+                P("data", None),          # error-feedback residual
+            ),
+            out_specs=(P("data", None), P(), P("data", None)),
+        )
+    else:
+        def local_rounds(X2, idx_round, ws_local, w):
+            w_l = _local_models(X2, idx_round, ws_local, w)
             return w_l[None, :], tree_allreduce_mean(w_l)
 
-    local_fn = data_parallel(
-        local_rounds, mesh,
-        in_specs=(
-            P("data", None),          # packed rows
-            P(None, "data", None),    # (L, S, ns) draws → (L, 1, ns)
-            P("data", None),          # per-replica models
-            P(),                      # center w
-        ),
-        out_specs=(P("data", None), P()),
-    )
+        local_fn = data_parallel(
+            local_rounds, mesh,
+            in_specs=(
+                P("data", None),          # packed rows
+                P(None, "data", None),    # (L, S, ns) draws → (L, 1, ns)
+                P("data", None),          # per-replica models
+                P(),                      # center w
+            ),
+            out_specs=(P("data", None), P()),
+        )
 
     combine = _make_combine(config, beta)
+
+    if sync is not None:
+        def train(X2, X_test, y_test, w0, ws0, delta0, res0, t0=0):
+            ts = jnp.arange(config.n_iterations) + t0
+            idx_all = prep_idx(ts)                # (T, L, S, ns)
+
+            def round_step(carry, x):
+                t, idx_round = x
+                w, ws, delta, res = carry
+                ws, w_avg, res = local_fn(X2, idx_round, ws, w, t, res)
+                w, delta = combine(w, w_avg, delta)
+                acc = (
+                    metrics.binary_accuracy(X_test @ w, y_test)
+                    if config.eval_test
+                    else jnp.float32(0)
+                )
+                return (w, ws, delta, res), acc
+
+            (w, ws, delta, res), accs = jax.lax.scan(
+                round_step, (w0, ws0, delta0, res0), (ts, idx_all)
+            )
+            return w, ws, delta, res, accs
+
+        return jax.jit(train)
 
     def train(X2, X_test, y_test, w0, ws0, delta0, t0=0):
         ts = jnp.arange(config.n_iterations) + t0
@@ -387,6 +522,54 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: LocalSGDConfig):
     return fn, X2, w0, ws0, delta0, meta
 
 
+def _train_comm(mesh, config: LocalSGDConfig, d, data_args, w0, ws0,
+                delta0, *, make_fn, checkpoint_dir, checkpoint_every,
+                tag, crop, fn=None):
+    """Comm-schedule round driver shared by the XLA and fused paths:
+    the carry/checkpoint state is ``(w, ws, delta, residual)`` — the
+    error-feedback residual is per-replica like ``ws`` and persists
+    across segments for bitwise resume."""
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.parallel import comms
+    from tpu_distalg.utils import metrics as _metrics
+
+    sync = _comm_sync(mesh, config, d)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    res0 = jax.device_put(jnp.asarray(sync.init_state()), shard2)
+
+    if checkpoint_dir is None:
+        fn = fn if fn is not None else make_fn(config.n_iterations)
+        w, ws, _, _, accs = fn(*data_args, w0, ws0, delta0, res0)
+        comms.emit_sync_counters(sync, config.n_iterations)
+        _metrics.guard_finite((w, ws), "local-SGD models")
+        return TrainResult(w=w[:crop], ws=ws[:, :crop], accs=accs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    def run_seg(seg_fn, state, t0):
+        w, ws, delta, res = state
+        ws = jax.device_put(jnp.asarray(ws), shard2)
+        res = jax.device_put(jnp.asarray(res), shard2)
+        w, ws, delta, res, accs = seg_fn(
+            *data_args, jnp.asarray(w), ws, jnp.asarray(delta), res,
+            t0=t0)
+        return (w, ws, delta, res), accs
+
+    (w, ws, delta, res), accs, start = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=make_fn, run_seg=run_seg,
+        state0=(w0, ws0, delta0, res0),
+        tag=f"{tag}:comm={config.comm}",
+    )
+    # only the rounds THIS process ran (resume skips the rest)
+    comms.emit_sync_counters(sync, config.n_iterations - start)
+    return TrainResult(
+        w=jnp.asarray(w)[:crop], ws=jnp.asarray(ws)[:, :crop],
+        accs=jnp.asarray(accs),
+    )
+
+
 def _train_fused(
     X_train, y_train, X_test, y_test, mesh: Mesh,
     config: LocalSGDConfig,
@@ -404,6 +587,19 @@ def _train_fused(
                ((0, 0), (0, meta["d_total"] - D)))
     )
     y_te = jnp.asarray(y_test)
+
+    if config.comm != "dense":
+        return _train_comm(
+            mesh, config, meta["d_total"], (X2, X_te, y_te),
+            w0, ws0, delta0,
+            make_fn=lambda seg: make_train_fn_fused(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                meta),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            tag=f"local_sgd:{config.global_update}:{config.sampler}",
+            crop=D, fn=fn,
+        )
 
     if checkpoint_dir is None:
         w, ws, _, accs = fn(X2, X_te, y_te, w0, ws0, delta0)
@@ -484,6 +680,19 @@ def train(
     else:
         delta0 = jnp.zeros((D,))
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+
+    if config.comm != "dense":
+        return _train_comm(
+            mesh, config, D,
+            (Xs.data, ys.data, Xs.mask, X_te, y_te), w0, ws0, delta0,
+            make_fn=lambda seg: make_train_fn(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                Xs.n_padded, d=D),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            tag=f"local_sgd:{config.global_update}",
+            crop=D,
+        )
 
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config, Xs.n_padded)

@@ -28,6 +28,10 @@ class LRConfig:
     eta: float = 0.1
     seed: int = 42
     init_seed: int = 7
+    # gradient-sync schedule (parallel/comms.py): 'dense' (bitwise the
+    # pre-comms psum), 'bucketed', 'hier', 'bf16', 'int8',
+    # 'topk[:frac]' (error-feedback residuals in the scan state)
+    comm: str = "dense"
 
 
 @dataclasses.dataclass
@@ -46,8 +50,59 @@ def _local_grad(X, y, mask, w):
     return tree_allreduce_sum((g, cnt))
 
 
-def make_train_fn(mesh: Mesh, config: LRConfig):
-    """Build the jitted whole-training function (scan over iterations)."""
+def _comm_sync(mesh, config: LRConfig, d: int):
+    from tpu_distalg.parallel import comms
+
+    example = (jax.ShapeDtypeStruct((d,), jnp.float32),
+               jax.ShapeDtypeStruct((), jnp.float32))
+    return comms.make_sync(config.comm, mesh, example)
+
+
+def make_train_fn(mesh: Mesh, config: LRConfig, *, d: int | None = None):
+    """Build the jitted whole-training function (scan over iterations).
+
+    With ``config.comm != 'dense'`` pass ``d`` (feature width); the
+    returned fn is then ``fn(X, y, valid, X_test, y_test, w0, res0,
+    t0=0)`` → ``(w, accs, res)`` with the comm residual threaded."""
+    if config.comm != "dense":
+        if d is None:
+            raise ValueError(
+                f"comm={config.comm!r} needs the feature width: call "
+                "make_train_fn(mesh, config, d=X.shape[1]) "
+                "(lr.train does this for you)")
+        sync = _comm_sync(mesh, config, d)
+
+        def _local_grad_comm(X, y, mask, w, t, res):
+            g, cnt = logistic.grad_sum(X, y, w, mask)
+            (g, cnt), res = sync.reduce((g, cnt), res, t)
+            return g, cnt, res
+
+        grad_fn = data_parallel(
+            _local_grad_comm,
+            mesh,
+            in_specs=(P("data", None), P("data"), P("data"), P(), P(),
+                      P("data", None)),
+            out_specs=(P(), P(), P("data", None)),
+        )
+
+        def train(X, y, valid, X_test, y_test, w0, res0, t0=0):
+            # absolute step ids: the int8 schedule's rounding key folds
+            # t in, so segmented resume replays identical noise
+            def step(carry, t):
+                w, res = carry
+                g, _, res = grad_fn(X, y, valid, w, t, res)
+                w = w - config.eta * g
+                acc = metrics.binary_accuracy(X_test @ w, y_test)
+                return (w, res), acc
+
+            (w, res), accs = jax.lax.scan(
+                step, (w0, res0),
+                jnp.arange(config.n_iterations) + t0,
+            )
+            return w, accs, res
+
+        return jax.jit(train)
+
     grad_fn = data_parallel(
         _local_grad,
         mesh,
@@ -89,6 +144,47 @@ def train(
         prng.root_key(config.init_seed), X_train.shape[1]
     )
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+
+    if config.comm != "dense":
+        from jax.sharding import NamedSharding
+
+        from tpu_distalg.parallel import comms
+
+        d = X_train.shape[1]
+        sync = _comm_sync(mesh, config, d)
+        res_sharding = NamedSharding(mesh, P("data", None))
+        res0 = jax.device_put(
+            jnp.asarray(sync.init_state()), res_sharding)
+        if checkpoint_dir is None:
+            fn = make_train_fn(mesh, config, d=d)
+            w, accs, _ = fn(
+                Xs.data, ys.data, Xs.mask, X_te, y_te, w0, res0)
+            comms.emit_sync_counters(sync, config.n_iterations)
+            metrics.guard_finite(w, "LR weights")
+            return TrainResult(w=w, accs=accs)
+
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        def run_seg(fn, state, t0):
+            w, res = state
+            res = jax.device_put(jnp.asarray(res), res_sharding)
+            w, accs, res = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
+                              jnp.asarray(w), res, t0=t0)
+            return (w, res), accs
+
+        (w, _), accs, start = ckpt.run_segmented(
+            checkpoint_dir, checkpoint_every, config.n_iterations,
+            make_seg_fn=lambda seg: make_train_fn(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                d=d),
+            run_seg=run_seg,
+            state0=(w0, res0),
+            tag=f"lr:comm={config.comm}",
+        )
+        # only the syncs THIS process ran (resume skips the rest)
+        comms.emit_sync_counters(sync, config.n_iterations - start)
+        return TrainResult(w=jnp.asarray(w), accs=jnp.asarray(accs))
+
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config)
         w, accs = fn(Xs.data, ys.data, Xs.mask, X_te, y_te, w0)

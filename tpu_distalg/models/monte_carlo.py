@@ -15,11 +15,15 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_distalg.ops import sampling
-from tpu_distalg.parallel import DATA_AXIS, data_parallel, replica_index
+from tpu_distalg.parallel import (
+    DATA_AXIS,
+    comms,
+    data_parallel,
+    replica_index,
+)
 from tpu_distalg.utils import prng
 
 
@@ -49,7 +53,7 @@ def estimate_pi(mesh: Mesh, config: MonteCarloConfig = MonteCarloConfig()):
         )
         # per-chunk psum stays ≤ 2^20 · n_shards: int32-safe; the final
         # (possibly > 2^31) total is summed in int64 on the host
-        return lax.psum(per_chunk, DATA_AXIS)
+        return comms.psum(per_chunk, DATA_AXIS)
 
     fn = data_parallel(
         local, mesh,

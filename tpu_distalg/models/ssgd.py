@@ -89,6 +89,16 @@ class SSGDConfig:
     # the forward matvec psums partial X_l·w_l over 'model', the gradient
     # contraction psums over 'data' only, and w lives sharded P('model')
     feature_sharded: bool = False
+    # gradient-sync schedule (parallel/comms.py): 'dense' (bitwise the
+    # pre-comms psum — the default), 'bucketed' (ppermute-chunk ring,
+    # overlapped bucket by bucket), 'hier' (reduce-scatter intra-group /
+    # ring across groups / all-gather), 'bf16', 'int8' (seeded
+    # stochastic rounding), 'topk[:frac]' (sparsified with
+    # error-feedback residuals carried in the scan state). Composes
+    # with samplers 'bernoulli', 'fused' and 'fused_gather'; the
+    # megakernel ('fused_train': no per-step collective exists to
+    # compress), 'fixed' and feature_sharded reject non-dense comm.
+    comm: str = "dense"
 
 
 @dataclasses.dataclass
@@ -99,6 +109,66 @@ class TrainResult:
     @property
     def final_acc(self) -> float:
         return float(self.accs[-1])
+
+
+def _comm_sync(mesh, config, d: int):
+    """The trainer's one :class:`~tpu_distalg.parallel.comms.CommSync`:
+    built identically wherever it is needed (scan builder, train(),
+    telemetry accounting) from the (Σ grad, count) sync pytree."""
+    import jax
+
+    from tpu_distalg.parallel import comms
+
+    example = (jax.ShapeDtypeStruct((d,), jnp.float32),
+               jax.ShapeDtypeStruct((), jnp.float32))
+    return comms.make_sync(config.comm, mesh, example)
+
+
+def _build_scan_comm(config: SSGDConfig, sample_and_grad, prep_xs=None):
+    """Comm-schedule variant of :func:`_build_scan`:
+    ``sample_and_grad(X, y, valid, w, payload, t, res)`` → (Σ grad,
+    count, res'); the flat error-feedback residual rides in the scan
+    carry (zero-width for stateless schedules) and is returned so
+    checkpointed runs can persist it — a dropped residual would silently
+    void the top-k convergence correction."""
+    if config.eval_every < 1:
+        raise ValueError(
+            f"eval_every must be >= 1, got {config.eval_every}"
+        )
+
+    def train(X, y, valid, X_test, y_test, w0, res0, t0=0, acc0=0.0):
+        ts = jnp.arange(config.n_iterations) + t0
+        xs = (ts, prep_xs(ts)) if prep_xs is not None else (ts, ts)
+
+        def step(carry, x):
+            w, last_acc, res = carry
+            t, payload = x
+            g, cnt, res = sample_and_grad(
+                X, y, valid, w, payload, t, res)
+            n_batch = jnp.maximum(cnt, 1.0)  # guard empty sample
+            reg = logistic.reg_gradient(
+                w, config.reg_type, config.elastic_alpha
+            )
+            w = w - config.eta * (g / n_batch + config.lam * reg)
+            if config.eval_test and config.eval_every == 1:
+                acc = metrics.binary_accuracy(X_test @ w, y_test)
+            elif config.eval_test:
+                acc = jax.lax.cond(
+                    t % config.eval_every == 0,
+                    lambda w: metrics.binary_accuracy(X_test @ w, y_test),
+                    lambda w: last_acc,
+                    w,
+                )
+            else:
+                acc = jnp.float32(0)
+            return (w, acc, res), acc
+
+        (w, _, res), accs = jax.lax.scan(
+            step, (w0, jnp.float32(acc0), res0), xs
+        )
+        return w, accs, res
+
+    return jax.jit(train)
 
 
 def _build_scan(config: SSGDConfig, sample_and_grad, prep_xs=None):
@@ -155,14 +225,22 @@ def _build_scan(config: SSGDConfig, sample_and_grad, prep_xs=None):
     return jax.jit(train)
 
 
-def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
-    """Build the jitted scan over ``n_iterations`` SSGD steps."""
+def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
+                  *, d: int | None = None):
+    """Build the jitted scan over ``n_iterations`` SSGD steps.
+
+    With ``config.comm != 'dense'`` the gradient sync runs the
+    comm-schedule path: pass ``d`` (the feature width, i.e. ``w``'s
+    length — the comm layer sizes its residual/byte accounting off it)
+    and call the returned fn as ``fn(X, y, valid, X_test, y_test, w0,
+    res0, t0=0, acc0=0.0)`` → ``(w, accs, res)``."""
     if config.sampler in ("fused", "fused_gather"):
         raise ValueError(
             f"sampler={config.sampler!r} packs labels into X — build via "
             "make_train_fn_fused(mesh, config, meta) with meta from "
             "pallas_kernels.pack_augmented, or use ssgd.train()"
         )
+    _check_comm_sampler(config)
     if config.feature_sharded:
         if config.sampler != "bernoulli" or config.use_pallas:
             raise ValueError(
@@ -177,6 +255,8 @@ def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
         return _make_train_fn_fixed(mesh, config, n_padded)
     if config.sampler != "bernoulli":
         raise ValueError(f"unknown sampler {config.sampler!r}")
+    if config.comm != "dense":
+        return _make_train_fn_comm(mesh, config, n_padded, d)
     if config.use_pallas:
         from tpu_distalg.ops import pallas_kernels
 
@@ -210,6 +290,72 @@ def make_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int):
     return _build_scan(config, sample_and_grad)
 
 
+def _check_comm_sampler(config: SSGDConfig) -> None:
+    """Reject schedule/sampler combinations that have no per-step
+    collective to re-schedule, up front and with the remedy named."""
+    if config.comm == "dense":
+        return
+    if config.feature_sharded:
+        raise ValueError(
+            "comm != 'dense' does not compose with feature_sharded "
+            "(the tp split's model-axis matvec psum is activation "
+            "traffic, not a gradient sync); run the comm schedules on "
+            "a pure-dp mesh"
+        )
+    if config.sampler in ("fused_train", "fixed"):
+        raise ValueError(
+            f"comm={config.comm!r} applies to the per-step gradient "
+            f"sync, which sampler={config.sampler!r} does not expose "
+            "('fused_train' fuses whole segments into one launch with "
+            "no per-step collective; 'fixed' is the measured-slower "
+            "legacy gather path) — use 'bernoulli', 'fused' or "
+            "'fused_gather'"
+        )
+
+
+def _make_train_fn_comm(mesh: Mesh, config: SSGDConfig, n_padded: int,
+                        d: int | None):
+    """Bernoulli-sampler scan with the comm-schedule gradient sync:
+    identical sampling and update math to :func:`make_train_fn`'s
+    default path — only the (Σ grad, count) allreduce goes through
+    :mod:`tpu_distalg.parallel.comms`."""
+    if d is None:
+        raise ValueError(
+            f"comm={config.comm!r} needs the feature width: call "
+            "make_train_fn(mesh, config, n_padded, d=X.shape[1]) "
+            "(ssgd.train does this for you)"
+        )
+    if config.use_pallas:
+        raise ValueError(
+            "comm != 'dense' composes with the XLA 'bernoulli' path "
+            "or the fused kernels, not use_pallas=True"
+        )
+    sync = _comm_sync(mesh, config, d)
+
+    def _local_grad(X, y, mask, w, t, res):
+        g, cnt = logistic.grad_sum(X, y, w, mask)
+        (g, cnt), res = sync.reduce((g, cnt), res, t)
+        return g, cnt, res
+
+    grad_fn = data_parallel(
+        _local_grad,
+        mesh,
+        in_specs=(P("data", None), P("data"), P("data"), P(), P(),
+                  P("data", None)),
+        out_specs=(P(), P(), P("data", None)),
+    )
+    key = prng.root_key(config.seed)
+
+    def sample_and_grad(X, y, valid, w, payload, t, res):
+        del payload  # == t on the bernoulli path
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid
+        )
+        return grad_fn(X, y, mask, w, t, res)
+
+    return _build_scan_comm(config, sample_and_grad)
+
+
 def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
     """dp×tp SSGD: rows sharded over 'data', features over 'model'.
 
@@ -218,17 +364,15 @@ def _make_train_fn_tp(mesh: Mesh, config: SSGDConfig, n_padded: int):
     of the gradient and of w. Caller pads the feature dim to a multiple of
     the model-axis size (zero columns are inert).
     """
-    from jax import lax
-
-    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS, comms
 
     key = prng.root_key(config.seed)
 
     def _local_grad(X, y, mask, w):
-        z = lax.psum(X @ w, MODEL_AXIS)            # (rows_l,) TP matvec
+        z = comms.psum(X @ w, MODEL_AXIS)          # (rows_l,) TP matvec
         resid = (jax.nn.sigmoid(z) - y) * mask
-        g = lax.psum(X.T @ resid, DATA_AXIS)       # my feature slice
-        cnt = lax.psum(jnp.sum(mask), DATA_AXIS)
+        g = comms.psum(X.T @ resid, DATA_AXIS)     # my feature slice
+        cnt = comms.psum(jnp.sum(mask), DATA_AXIS)
         return g, cnt
 
     grad_fn = data_parallel(
@@ -317,6 +461,9 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
     col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(jnp.float32)
     n_shards = mesh.shape[DATA_AXIS]
     prep_xs = None
+    _check_comm_sampler(config)
+    sync = (_comm_sync(mesh, config, d_t)
+            if config.comm != "dense" else None)
 
     if config.sampler == "fused_train":
         return _make_train_fn_mega(mesh, config, meta, on_tpu, n_shards)
@@ -346,13 +493,23 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
                 )
             )(ts)                                        # (T, S, ns)
 
-        def _local_grad(X2, w, idx_shards):
-            shard = lax.axis_index(DATA_AXIS)
-            idx = lax.dynamic_index_in_dim(
-                idx_shards, shard, keepdims=False
-            )
-            g, cnt = kern(X2, w, idx)
-            return tree_allreduce_sum((g * col_keep, cnt))
+        if sync is not None:
+            def _local_grad(X2, w, idx_shards, t, res):
+                shard = lax.axis_index(DATA_AXIS)
+                idx = lax.dynamic_index_in_dim(
+                    idx_shards, shard, keepdims=False
+                )
+                g, cnt = kern(X2, w, idx)
+                (g, cnt), res = sync.reduce((g * col_keep, cnt), res, t)
+                return g, cnt, res
+        else:
+            def _local_grad(X2, w, idx_shards):
+                shard = lax.axis_index(DATA_AXIS)
+                idx = lax.dynamic_index_in_dim(
+                    idx_shards, shard, keepdims=False
+                )
+                g, cnt = kern(X2, w, idx)
+                return tree_allreduce_sum((g * col_keep, cnt))
     else:
         if not on_tpu:
             raise ValueError(
@@ -367,10 +524,33 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
             block_rows=config.fused_block_rows,
         )
 
-        def _local_grad(X2, w, t):
-            shard = lax.axis_index(DATA_AXIS)
-            g, cnt = kern(X2, w, t + config.seed, shard)
-            return tree_allreduce_sum((g * col_keep, cnt))
+        if sync is not None:
+            def _local_grad(X2, w, t_payload, t, res):
+                shard = lax.axis_index(DATA_AXIS)
+                g, cnt = kern(X2, w, t_payload + config.seed, shard)
+                (g, cnt), res = sync.reduce((g * col_keep, cnt), res, t)
+                return g, cnt, res
+        else:
+            def _local_grad(X2, w, t):
+                shard = lax.axis_index(DATA_AXIS)
+                g, cnt = kern(X2, w, t + config.seed, shard)
+                return tree_allreduce_sum((g * col_keep, cnt))
+
+    if sync is not None:
+        grad_fn = data_parallel(
+            _local_grad,
+            mesh,
+            in_specs=(P("data", None), P(), P(), P(),
+                      P("data", None)),
+            out_specs=(P(), P(), P("data", None)),
+        )
+
+        def sample_and_grad(X2, y, valid, w, x, t, res):
+            del y, valid  # labels/validity ride inside the packed X2
+            return grad_fn(X2, w, x, t, res)
+
+        return _build_scan_comm(config, sample_and_grad,
+                                prep_xs=prep_xs)
 
     grad_fn = data_parallel(
         _local_grad,
@@ -556,7 +736,7 @@ def make_train_fn_fused_tp(mesh: Mesh, config: SSGDConfig, meta: dict):
     The one-pass kernel cannot feature-shard: the residual needs the
     GLOBAL matvec ``z = Σ_m X_m·w_m``. So each step runs
     ``fused_forward_gathered`` (partial z + local y/v on this shard's
-    feature slice), one ``psum(z, 'model')``, then
+    feature slice), one ``comms.psum(z, 'model')``, then
     ``fused_backward_gathered`` (residᵀ·X on the slice) — the sampled
     blocks are read TWICE, i.e. 2× the per-chip HBM bytes of pure dp at
     equal chip count. Measured on the v5e chip (1M×128 benchmark
@@ -570,10 +750,8 @@ def make_train_fn_fused_tp(mesh: Mesh, config: SSGDConfig, meta: dict):
     """
     import functools
 
-    from jax import lax
-
     from tpu_distalg.ops import pallas_kernels
-    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS, comms
 
     on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
     d_t = meta["d_total"]
@@ -603,12 +781,12 @@ def make_train_fn_fused_tp(mesh: Mesh, config: SSGDConfig, meta: dict):
     def _local_grad(X2, w_l, idx_local):
         idx = idx_local[0]                           # (ns,)
         zyv = fwd(X2, w_l, idx)                      # (ns·bp, 3P)
-        z = lax.psum(zyv[:, :Pk], MODEL_AXIS)        # TP matvec
+        z = comms.psum(zyv[:, :Pk], MODEL_AXIS)      # TP matvec
         y, v = zyv[:, Pk:2 * Pk], zyv[:, 2 * Pk:]    # local (replicated)
         resid = (jax.nn.sigmoid(z) - y) * v
         g_l = bwd(X2, resid, idx) * col_keep         # my feature slice
-        g_l = lax.psum(g_l, DATA_AXIS)
-        cnt = lax.psum(jnp.sum(v), DATA_AXIS)
+        g_l = comms.psum(g_l, DATA_AXIS)
+        cnt = comms.psum(jnp.sum(v), DATA_AXIS)
         return g_l, cnt
 
     grad_fn = data_parallel(
@@ -740,6 +918,7 @@ def train(
     # compiled schedule wedges (checkpointed runs also mark per segment
     # inside run_segmented)
     tevents.mark(f"ssgd:{config.sampler}", emit_event=False)
+    _check_comm_sampler(config)
     if config.sampler in ("fused", "fused_gather", "fused_train"):
         if config.feature_sharded:
             if config.sampler != "fused_gather":
@@ -784,6 +963,19 @@ def train(
         w0 = jax.device_put(w0, NamedSharding(mesh, P("model")))
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
 
+    if config.comm != "dense":
+        return _train_comm(
+            mesh, config, d_orig,
+            (X_data, ys.data, Xs.mask, X_te, y_te), w0,
+            make_fn=lambda seg: make_train_fn(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                Xs.n_padded, d=d_orig),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            tag=f"ssgd:{config.sampler}",
+            crop=d_orig,
+        )
+
     if checkpoint_dir is None:
         fn = make_train_fn(mesh, config, Xs.n_padded)
         w, accs = fn(X_data, ys.data, Xs.mask, X_te, y_te, w0)
@@ -803,6 +995,49 @@ def train(
         tag=f"ssgd:{config.sampler}",
     )
     return TrainResult(w=jnp.asarray(w)[:d_orig], accs=jnp.asarray(accs))
+
+
+def _train_comm(mesh, config, d, data_args, w0, *, make_fn,
+                checkpoint_dir, checkpoint_every, tag, crop, fn=None):
+    """Comm-schedule training driver shared by the XLA and fused paths:
+    the scan carry/checkpoint state is ``(w, last_acc, residual)`` —
+    the flat error-feedback residual persists across segments, so a
+    resumed top-k run replays bitwise (satellite-tested round-trip)."""
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.parallel import comms
+
+    sync = _comm_sync(mesh, config, d)
+    res_sharding = NamedSharding(mesh, P("data", None))
+    res0 = jax.device_put(jnp.asarray(sync.init_state()), res_sharding)
+
+    if checkpoint_dir is None:
+        fn = fn if fn is not None else make_fn(config.n_iterations)
+        w, accs, _ = fn(*data_args, w0, res0)
+        comms.emit_sync_counters(sync, config.n_iterations)
+        metrics.guard_finite(w, "SSGD weights")
+        return TrainResult(w=w[:crop], accs=accs)
+
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    def run_seg(fn, state, t0):
+        w, acc0, res = state
+        res = jax.device_put(jnp.asarray(res), res_sharding)
+        w, accs, res = fn(*data_args, jnp.asarray(w), res, t0=t0,
+                          acc0=jnp.asarray(acc0))
+        return (w, accs[-1], res), accs
+
+    (w, _, _), accs, start = ckpt.run_segmented(
+        checkpoint_dir, checkpoint_every, config.n_iterations,
+        make_seg_fn=make_fn,
+        run_seg=run_seg,
+        state0=(w0, jnp.float32(0), res0),
+        tag=f"{tag}:comm={config.comm}",
+    )
+    # count only the syncs THIS process ran — a resumed run performed
+    # n_iterations - start, not the full schedule
+    comms.emit_sync_counters(sync, config.n_iterations - start)
+    return TrainResult(w=jnp.asarray(w)[:crop], accs=jnp.asarray(accs))
 
 
 def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
@@ -1000,6 +1235,18 @@ def _train_fused(
     )
     y_te = jnp.asarray(y_test)
     dummy = jnp.zeros((1,), jnp.float32)
+    if config.comm != "dense":
+        return _train_comm(
+            mesh, config, meta["d_total"],
+            (X2, dummy, dummy, X_te, y_te), w0,
+            make_fn=lambda seg: make_train_fn_fused(
+                mesh, dataclasses.replace(config, n_iterations=seg),
+                meta),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            tag=f"ssgd:{config.sampler}",
+            crop=d_orig, fn=fn,
+        )
     if checkpoint_dir is None:
         w, accs = fn(X2, dummy, dummy, X_te, y_te, w0)
         metrics.guard_finite(w, "SSGD (fused) weights")

@@ -31,6 +31,11 @@ from tpu_distalg.parallel.collectives import (
     tree_allreduce_sum,
     ring_shift,
 )
+from tpu_distalg.parallel.comms import (
+    CommSpec,
+    CommSync,
+    make_sync,
+)
 from tpu_distalg.parallel.spmd import data_parallel, replica_index
 from tpu_distalg.parallel.ring import (
     alltoall_head_to_seq,
@@ -44,10 +49,13 @@ from tpu_distalg.parallel.ring import (
 )
 
 __all__ = [
+    "CommSpec",
+    "CommSync",
     "DATA_AXIS",
     "MODEL_AXIS",
     "MeshContext",
     "ShardedMatrix",
+    "make_sync",
     "all_gather",
     "all_to_all",
     "alltoall_head_to_seq",

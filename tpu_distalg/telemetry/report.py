@@ -215,6 +215,26 @@ def render(s: dict) -> str:
     if s["counters"]:
         lines.append("counters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["counters"].items())))
+        bw = s["counters"].get("comm.bytes_wire")
+        bl = s["counters"].get("comm.bytes_logical")
+        if bw and bl:
+            # the comms layer's achieved ratio (parallel/comms.py):
+            # logical f32 payload vs bytes actually put on the wire by
+            # the selected --comm schedule. Uncompressed f32 schedules
+            # legitimately put MORE on the wire than the payload (a
+            # ring allreduce moves 2(n-1)/n of it) — say so instead of
+            # printing a "0.7x compression" that reads as a bug.
+            if bl >= bw:
+                desc = f"({bl / bw:.1f}x compression)"
+            else:
+                desc = (f"({bw / bl:.1f}x wire/logical — "
+                        f"uncompressed ring allreduce moves "
+                        f"2(n-1)/n of the payload)")
+            lines.append(
+                f"comm: {bw} bytes wire / {bl} logical {desc} over "
+                f"{s['counters'].get('comm.syncs', 0)} sync(s), "
+                f"{s['counters'].get('comm.rounds', 0)} collective "
+                f"round(s)")
     if s["gauges"]:
         lines.append("gauges: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["gauges"].items())))
