@@ -134,6 +134,11 @@ _LINES = []
 # _emit_summary emits through _emit while already holding it.
 _EMIT_LOCK = threading.RLock()
 _T0 = time.monotonic()   # bench start — the hard-deadline budget clock
+# set to "cpu" when the CPU-fallback tier is driving the round: every
+# metric line and the summary carry the tag, so the artifact can never
+# masquerade as a TPU round (bench_artifacts skips cpu-tagged artifacts
+# when resolving the claims/tripwire reference)
+_BACKEND_TAG = None
 
 
 def _emit(obj):
@@ -197,12 +202,19 @@ def _emit_summary():
         head = _SUMMARY.get(
             flag,
             {"value": 0.0, "unit": "steps/s/chip", "vs_baseline": None})
-        ref, regressions = _regressions()
+        if _BACKEND_TAG == "cpu":
+            # a CPU-fallback round's values are not comparable to the
+            # TPU reference — flagging every metric as a "regression"
+            # would drown the tripwire in noise
+            ref, regressions = None, {}
+        else:
+            ref, regressions = _regressions()
         _emit({
             "metric": flag,
             "value": head["value"],
             "unit": head["unit"],
             "vs_baseline": head["vs_baseline"],
+            **({"backend": _BACKEND_TAG} if _BACKEND_TAG else {}),
             "all_metrics": {k: v["value"] for k, v in _SUMMARY.items()},
             "all_units": {k: v["unit"] for k, v in _SUMMARY.items()},
             "all_vs_baseline": {k: v["vs_baseline"]
@@ -463,6 +475,136 @@ def run_comm_comparison(mesh, emit, schedules=COMM_SCHEDULES,
 def _bench_comm(mesh, n_chips):
     """The comm-comparison phase — see :func:`run_comm_comparison`."""
     run_comm_comparison(mesh, _emit)
+
+
+#: comm-bound geometry for the measured step-time comparison: a wide
+#: model (4 MB f32 gradient) over a tiny per-shard row count, so the
+#: per-step sync dominates the matvec — the regime the compressed
+#: schedules exist for
+COMM_SPEEDUP_D = 1 << 20
+COMM_SPEEDUP_ROWS_PER_SHARD = 8
+
+
+def run_comm_step_speedup(mesh, emit, *, d=COMM_SPEEDUP_D,
+                          rows_per_shard=COMM_SPEEDUP_ROWS_PER_SHARD,
+                          steps=30, repeats=3):
+    """MEASURED step-time of the native-wire compressed schedules vs
+    dense (ROADMAP open item 4: the win must be step-time, not
+    bytes-accounted): full SSGD training steps at a comm-bound
+    geometry, ``ssgd_comm_{int8,topk}_step_speedup`` = compressed
+    steps/s ÷ dense steps/s, emitted (like the wire-reduction pair) at
+    the canonical :data:`COMM_CANONICAL_SHARDS` geometry, with the
+    per-schedule step rates recorded on every multi-shard mesh.
+
+    The int8 schedule also runs its ``@seq`` A/B (the bitwise-identical
+    sequential bucket loop) to measure what the double-buffered overlap
+    pipeline hides: ``overlap_hidden_ms_per_step`` = sequential −
+    overlapped step time, fed into the ``comm.overlap_hidden_ms`` /
+    ``comm.sync_ms`` counters that ``tda report`` renders as the
+    overlap-efficiency line.
+
+    Honesty note, recorded in the line's ``wire`` field: on a real
+    interconnect (TPU ICI/DCN) the sync's wire time is what the int8
+    ring cuts 4x and the pipeline hides, so the ratio is the claim; on
+    a single-host CPU mesh the "wire" is shared memory — a fused XLA
+    AllReduce with no transfer to compress — so quantize/ring work is
+    pure overhead there and the measured ratio honestly reads < 1.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.parallel import comms, parallelize
+    from tpu_distalg.utils import profiling
+
+    n_shards = int(mesh.shape["data"])
+    if n_shards < 2:
+        return  # no per-step collective exists to re-schedule
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    rows = rows_per_shard * n_shards
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((rows, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0) \
+        .astype(np.float32)
+    Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
+    Xt = jnp.zeros((1, d), jnp.float32)
+    yt = jnp.zeros((1,), jnp.float32)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    def rate(sched):
+        cfg = ssgd.SSGDConfig(n_iterations=steps, eval_test=False,
+                              comm=sched, mini_batch_fraction=1.0)
+        fn = ssgd.make_train_fn(mesh, cfg, Xs.n_padded, d=d)
+        if sched == "dense":
+            timed = lambda: fn(Xs.data, ys.data, Xs.mask,  # noqa: E731
+                               Xt, yt, w0)
+        else:
+            sync = ssgd._comm_sync(mesh, cfg, d)
+            res0 = jax.device_put(
+                jnp.asarray(sync.init_state()),
+                NamedSharding(mesh, P("data", None)))
+            timed = lambda: fn(Xs.data, ys.data, Xs.mask,  # noqa: E731
+                               Xt, yt, w0, res0)
+        best, spread = profiling.steps_per_sec(
+            timed, steps=steps, repeats=repeats, with_stats=True)
+        return best, spread
+
+    dense_rate, dense_spread = rate("dense")
+    wire = ("ici/dcn" if on_tpu
+            else "emulated (single-host shared memory — no transfer "
+                 "to compress, so compressed schedules read < 1 here; "
+                 "the claim geometry is a real interconnect)")
+    # topk has no @seq A/B: the single-bucket pipeline is trace-
+    # identical either way, so a seq pass would burn a full run to
+    # measure jitter and publish it as a calibrated hidden_ms
+    for tag, ov_spec, seq_spec in (
+            ("int8", "int8", "int8@seq"),
+            ("topk", "topk:0.01", None)):
+        ov_rate, ov_spread = rate(ov_spec)
+        seq_rate = rate(seq_spec)[0] if seq_spec else ov_rate
+        # what the double-buffered pipeline hid (vs its bitwise-equal
+        # sequential A/B), and the comm time still exposed over dense
+        hidden_ms = max(0.0, (1.0 / seq_rate - 1.0 / ov_rate) * 1e3)
+        exposed_ms = max(0.0, (1.0 / ov_rate - 1.0 / dense_rate) * 1e3)
+        if tag == "int8":
+            # the report's overlap-efficiency line describes ONE
+            # schedule's pipeline, not a blend: only the multi-bucket
+            # int8 ring (the schedule the pipeline exists for) feeds
+            # the counters; topk's single pair-buffer A/B is a no-op
+            # by construction and is recorded in its line fields only
+            comms.emit_overlap_counters(hidden_ms * steps,
+                                        exposed_ms * steps)
+        line = {
+            "metric": f"ssgd_comm_{tag}_step_speedup",
+            "value": round(ov_rate / dense_rate, 3),
+            "unit": "x",
+            "vs_baseline": None,
+            "steps_per_sec": round(ov_rate, 2),
+            "dense_steps_per_sec": round(dense_rate, 2),
+            "sequential_steps_per_sec": round(seq_rate, 2),
+            "overlap_hidden_ms_per_step": round(hidden_ms, 3),
+            "comm_exposed_ms_per_step": round(exposed_ms, 3),
+            "d": d, "rows": rows, "n_shards": n_shards,
+            "steps": steps, "wire": wire,
+            "dense_spread": dense_spread, "spread": ov_spread,
+            "note": "full SSGD steps at a comm-bound geometry "
+                    "(4 MB f32 gradient, tiny per-shard matvec); "
+                    "measured step time, not byte accounting",
+        }
+        if n_shards != COMM_CANONICAL_SHARDS:
+            # off-geometry meshes still record the measurement, under
+            # a shard-count-suffixed name so the canonical claim metric
+            # can never be overwritten by another geometry
+            line["metric"] += f"_at_{n_shards}shards"
+        emit(line)
+
+
+def _bench_comm_speedup(mesh, n_chips):
+    """The measured step-time phase — see
+    :func:`run_comm_step_speedup`."""
+    run_comm_step_speedup(mesh, _emit)
 
 
 def _bench_ssgd(mesh, on_tpu, n_chips, comm="dense"):
@@ -1536,6 +1678,305 @@ def _bench_ring_attention(mesh, n_chips):
     })
 
 
+#: every metric name a full TPU round records — the CPU-fallback tier
+#: guarantees a line for EACH of these (measured where CPU-feasible,
+#: explicitly skipped-with-zero where the workload needs the TPU), so
+#: no round is ever blank again (ROADMAP hygiene rider: r05 recorded
+#: zero metrics when the backend never came up)
+ALL_METRIC_NAMES = (
+    "ssgd_lr_steps_per_sec_per_chip",
+    "ssgd_lr_fused_gather_steps_per_sec_per_chip",
+    "ssgd_comm_dense_bytes_wire_per_sync",
+    "ssgd_comm_bucketed_bytes_wire_per_sync",
+    "ssgd_comm_bf16_bytes_wire_per_sync",
+    "ssgd_comm_int8_bytes_wire_per_sync",
+    "ssgd_comm_topk_bytes_wire_per_sync",
+    "ssgd_comm_hier_bytes_wire_per_sync",
+    "ssgd_comm_int8_wire_reduction_vs_dense",
+    "ssgd_comm_topk_wire_reduction_vs_dense",
+    "ssgd_comm_int8_step_speedup",
+    "ssgd_comm_topk_step_speedup",
+    "ssgd_lr_100m_rows_steps_per_sec_per_chip",
+    "ssgd_lr_1b_rows_virtual_steps_per_sec_per_chip",
+    "ssgd_lr_32gb_streamed_steps_per_sec_per_chip",
+    "ma_local_sgd_local_steps_per_sec_per_chip",
+    "kmeans_10m_iters_per_sec_per_chip",
+    "pagerank_1m_iters_per_sec",
+    "als_4kx16k_sweeps_per_sec_per_chip",
+    "als_4kx16k_noisy_ridge_sweeps_per_sec_per_chip",
+    "ring_attention_32k_tokens_per_sec_per_chip",
+    "ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip",
+    "ring_attention_128k_tokens_per_sec_per_chip",
+    "ring_attention_128k_fwd_bwd_tokens_per_sec_per_chip",
+    "kmeans_18gb_streamed_steps_per_sec_per_chip",
+    "als_17gb_streamed_sweeps_per_sec_per_chip",
+)
+
+#: canonical units, for the skipped-with-zero lines
+_METRIC_UNITS = {
+    "pagerank_1m_iters_per_sec": "iter/s/chip",
+    "kmeans_10m_iters_per_sec_per_chip": "iter/s/chip",
+    "ma_local_sgd_local_steps_per_sec_per_chip": "local steps/s/chip",
+    "als_4kx16k_sweeps_per_sec_per_chip": "sweeps/s/chip",
+    "als_4kx16k_noisy_ridge_sweeps_per_sec_per_chip": "sweeps/s/chip",
+    "als_17gb_streamed_sweeps_per_sec_per_chip": "sweeps/s/chip",
+    "ssgd_comm_int8_wire_reduction_vs_dense": "x",
+    "ssgd_comm_topk_wire_reduction_vs_dense": "x",
+    "ssgd_comm_int8_step_speedup": "x",
+    "ssgd_comm_topk_step_speedup": "x",
+    "ring_attention_32k_tokens_per_sec_per_chip": "tokens/s/chip",
+    "ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip":
+        "tokens/s/chip",
+    "ring_attention_128k_tokens_per_sec_per_chip": "tokens/s/chip",
+    "ring_attention_128k_fwd_bwd_tokens_per_sec_per_chip":
+        "tokens/s/chip",
+}
+for _n in ALL_METRIC_NAMES:
+    _METRIC_UNITS.setdefault(
+        _n, "bytes/sync/shard" if "bytes_wire" in _n
+        else "steps/s/chip")
+
+
+def _cpu_emit(obj):
+    """CPU-tier emitter: every line carries the backend tag."""
+    _emit({**obj, "backend": "cpu"})
+
+
+def _emit_missing_as_skipped():
+    """A line for every canonical metric the CPU tier could not
+    measure: value 0.0 + the skip reason, tagged ``backend: cpu`` —
+    parsers see the full metric set, never a blank."""
+    with _EMIT_LOCK:
+        missing = [n for n in ALL_METRIC_NAMES if n not in _SUMMARY]
+    for name in missing:
+        _cpu_emit({
+            "metric": name,
+            "value": 0.0,
+            "unit": _METRIC_UNITS[name],
+            "vs_baseline": None,
+            "skipped": "requires the tpu backend (cpu fallback tier)",
+        })
+
+
+def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
+    """The CPU-fallback bench tier (ROADMAP hygiene rider): the axon
+    backend never came up, so run every CPU-feasible phase on a
+    host-device mesh — honest (degraded-geometry) measurements, each
+    line tagged ``backend: cpu`` — and emit explicit skipped-with-zero
+    lines for the TPU-only workloads. The artifact records the FULL
+    metric set either way; rc stays 2 so the driver still sees the
+    backend failure. ``fast=True`` shrinks geometries to unit-test
+    scale."""
+    global _BACKEND_TAG
+    _BACKEND_TAG = "cpu"
+    tevents.emit("cpu_fallback", reason=reason)
+    print(f"[bench] backend unavailable ({reason}); running the CPU "
+          f"fallback tier — all lines tagged backend: cpu",
+          file=sys.stderr)
+
+    import jax
+
+    try:
+        # the TPU platform never initialised, so the CPU backend can
+        # still be selected; more virtual devices would need XLA_FLAGS
+        # set before the first backend touch (the driver/conftest does)
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # a backend is already live; use whatever it exposes
+    from tpu_distalg.parallel import get_mesh
+
+    try:
+        devs = jax.devices()
+        n_shards = 4 if len(devs) >= 4 else 1
+        mesh = get_mesh(data=n_shards, devices=devs[:n_shards])
+    except Exception as e:  # noqa: BLE001 — recorded, summary still out
+        tevents.emit("cpu_fallback_failed",
+                     error=f"{type(e).__name__}: {e}")
+        print(f"[bench] cpu fallback could not build a mesh: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        _emit_summary()
+        return 2
+
+    import jax.numpy as jnp
+
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.parallel import parallelize
+    from tpu_distalg.utils import datasets, profiling
+
+    def cpu_ssgd():
+        # the flagship metric on the CPU XLA path: canonical 1M-row
+        # geometry unless fast, honest (slow) steps/s
+        n_rows = (1 << 14) if fast else N_ROWS
+        steps = 5 if fast else 30
+        X, y = datasets.synthetic_two_class(n_rows, N_FEATURES, seed=0)
+        X = datasets.add_bias_column(X)
+        cfg = ssgd.SSGDConfig(n_iterations=steps, eval_test=False)
+        Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
+        from tpu_distalg.ops import logistic
+        from tpu_distalg.utils import prng
+
+        w0 = logistic.init_weights(prng.root_key(7), X.shape[1])
+        fn = ssgd.make_train_fn(mesh, cfg, Xs.n_padded)
+        ev = (jnp.zeros((1, X.shape[1]), jnp.float32),
+              jnp.zeros((1,), jnp.float32))
+        best, spread = profiling.steps_per_sec(
+            lambda: fn(Xs.data, ys.data, Xs.mask, ev[0], ev[1], w0),
+            steps=steps, repeats=1 if fast else 2, with_stats=True)
+        _cpu_emit({
+            "metric": "ssgd_lr_steps_per_sec_per_chip",
+            "value": round(best / n_shards, 2),
+            "unit": "steps/s/chip",
+            "vs_baseline": None,
+            "sampler": "bernoulli", "n_rows": n_rows,
+            "degraded_geometry": n_rows != N_ROWS,
+            "spread": spread,
+        })
+
+    def cpu_pagerank():
+        from tpu_distalg.models import pagerank
+        from tpu_distalg.ops import graph as gops
+
+        n_v = (1 << 12) if fast else PR_VERTICES
+        iters = 3 if fast else 10
+        edges = datasets.erdos_renyi_edges(n_v, PR_AVG_DEGREE, seed=0)
+        el = gops.prepare_edges(edges, n_v)
+        fn = pagerank.make_run_fn(
+            mesh, pagerank.PageRankConfig(n_iterations=iters,
+                                          mode="standard"), el.n_vertices)
+        de = pagerank.prepare_device_edges(el, mesh)
+        best, spread = profiling.steps_per_sec(
+            lambda: fn(de.src, de.dst, de.w_e, de.emask, de.has_out,
+                       de.n_ref),
+            steps=iters, repeats=1 if fast else 2, with_stats=True)
+        _cpu_emit({
+            "metric": "pagerank_1m_iters_per_sec",
+            "value": round(best / n_shards, 3),
+            "unit": "iter/s/chip",
+            "vs_baseline": None,
+            "n_vertices": n_v, "n_edges": int(el.n_edges),
+            "degraded_geometry": n_v != PR_VERTICES,
+            "spread": spread,
+        })
+
+    def cpu_kmeans():
+        from tpu_distalg.models import kmeans
+        from tpu_distalg.parallel import build_sharded
+
+        n_rows = (1 << 12) if fast else 1 << 20
+        k, dim, iters = 8, 16, 3 if fast else 10
+        make_rows, _ = datasets.gaussian_mixture_rows(
+            k=k, dim=dim, seed=0, spread=8.0)
+        cfg = kmeans.KMeansConfig(k=k, n_iterations=iters, seed=0,
+                                  init="farthest")
+        ps = build_sharded(mesh, n_rows, make_rows)
+        c0 = kmeans.init_centers_scaled(make_rows, n_rows, cfg)
+        fn = kmeans.make_fit_fn(mesh, cfg)
+        best, spread = profiling.steps_per_sec(
+            lambda: fn(ps.data, ps.mask, c0),
+            steps=iters, repeats=1 if fast else 2, with_stats=True)
+        _cpu_emit({
+            "metric": "kmeans_10m_iters_per_sec_per_chip",
+            "value": round(best / n_shards, 3),
+            "unit": "iter/s/chip",
+            "vs_baseline": None,
+            "n_points": n_rows, "k": k, "dim": dim,
+            "degraded_geometry": True,
+            "spread": spread,
+        })
+
+    def cpu_als():
+        import jax as _jax
+
+        from tpu_distalg.models import als
+        from tpu_distalg.utils import prng
+
+        m, n, k = ((256, 512, 16) if fast else (1024, 4096, 32))
+        sweeps = 2 if fast else 5
+        key = prng.root_key(0)
+        U0 = _jax.random.normal(_jax.random.fold_in(key, 0), (m, k)) * .3
+        V0 = _jax.random.normal(_jax.random.fold_in(key, 1), (n, k)) * .3
+        R = U0 @ V0.T
+        Ui = _jax.random.normal(_jax.random.fold_in(key, 2), (m, k)) * .1
+        Vi = _jax.random.normal(_jax.random.fold_in(key, 3), (n, k)) * .1
+        for metric, lam in (
+                ("als_4kx16k_sweeps_per_sec_per_chip", 0.0),
+                ("als_4kx16k_noisy_ridge_sweeps_per_sec_per_chip", .01)):
+            cfg = als.ALSConfig(m=m, n=n, k=k, lam=lam,
+                                n_iterations=sweeps)
+            fn = als.make_fit_fn(mesh, cfg)
+            best, spread = profiling.steps_per_sec(
+                lambda: fn(R, Ui, Vi), steps=sweeps,
+                repeats=1 if fast else 2, with_stats=True)
+            _cpu_emit({
+                "metric": metric,
+                "value": round(best / n_shards, 3),
+                "unit": "sweeps/s/chip",
+                "vs_baseline": None,
+                "m": m, "n": n, "k": k, "lam": lam,
+                "degraded_geometry": True,
+                "spread": spread,
+            })
+
+    def cpu_local_sgd():
+        from tpu_distalg.models import ma
+
+        n_rows = (1 << 12) if fast else 1 << 16
+        rounds, n_local = (2, 2) if fast else (5, 5)
+        X, y = datasets.synthetic_two_class(n_rows, N_FEATURES, seed=0)
+        X = datasets.add_bias_column(X)
+        cfg = ma.MAConfig(n_iterations=rounds,
+                          n_local_iterations=n_local, eval_test=False)
+        from tpu_distalg.models import local_sgd as lsgd
+        from tpu_distalg.ops import logistic
+        from tpu_distalg.utils import prng
+
+        Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
+        fn = lsgd.make_train_fn(mesh, cfg, Xs.n_padded)
+        import jax as _jax
+
+        k_init = prng.root_key(cfg.init_seed)
+        w0 = logistic.init_weights(_jax.random.fold_in(k_init, 0),
+                                   X.shape[1])
+        ws0 = _jax.random.uniform(
+            _jax.random.fold_in(k_init, 1), (n_shards, X.shape[1]),
+            minval=-1.0, maxval=1.0)
+        ev = (jnp.zeros((1, X.shape[1]), jnp.float32),
+              jnp.zeros((1,), jnp.float32))
+        best, spread = profiling.steps_per_sec(
+            lambda: fn(Xs.data, ys.data, Xs.mask, ev[0], ev[1], w0,
+                       ws0, jnp.zeros((X.shape[1],), jnp.float32)),
+            steps=rounds * n_local, repeats=1 if fast else 2,
+            with_stats=True)
+        _cpu_emit({
+            "metric": "ma_local_sgd_local_steps_per_sec_per_chip",
+            "value": round(best / n_shards, 2),
+            "unit": "local steps/s/chip",
+            "vs_baseline": None,
+            "sampler": "bernoulli", "n_rows": n_rows,
+            "degraded_geometry": True,
+            "spread": spread,
+        })
+
+    import functools
+
+    _phase_optional("cpu_ssgd", cpu_ssgd)
+    _phase_optional(
+        "cpu_comm", run_comm_comparison, mesh, _cpu_emit,
+        COMM_SCHEDULES, 8 if fast else 300)
+    _phase_optional(
+        "cpu_comm_speedup",
+        functools.partial(
+            run_comm_step_speedup, mesh, _cpu_emit,
+            **(dict(d=1 << 14, steps=4, repeats=1) if fast else {})))
+    _phase_optional("cpu_pagerank", cpu_pagerank)
+    _phase_optional("cpu_kmeans", cpu_kmeans)
+    _phase_optional("cpu_als", cpu_als)
+    _phase_optional("cpu_local_sgd", cpu_local_sgd)
+    _emit_missing_as_skipped()
+    _emit_summary()
+    return 2
+
+
 def main(argv=None):
     import argparse
 
@@ -1605,9 +2046,13 @@ def _run(args):
             backoff=INIT_RETRY_SECONDS,
             backoff_cap=INIT_RETRY_SECONDS,
             init_fn=get_mesh)
-    except tsupervisor.BackendUnavailableError:
-        _emit_summary()  # zero-value flagship line, honest artifact
-        return 2
+    except tsupervisor.BackendUnavailableError as e:
+        # the CPU-fallback tier (ROADMAP hygiene rider): r05 recorded
+        # ZERO metrics when the backend never came up — now every
+        # canonical metric line is emitted, measured on host devices
+        # where feasible and skipped-with-zero where not, all tagged
+        # backend: cpu
+        return _run_cpu_fallback(str(e))
     import jax
 
     n_chips = len(jax.devices())
@@ -1620,6 +2065,7 @@ def _run(args):
             ssgd_per_chip = _phase("ssgd", _bench_ssgd, mesh, on_tpu,
                                    n_chips, args.comm)
             _phase("comm", _bench_comm, mesh, n_chips)
+            _phase("comm_speedup", _bench_comm_speedup, mesh, n_chips)
             if on_tpu:
                 _phase("ssgd_100m", _bench_ssgd_scale, mesh, n_chips)
                 _phase("ssgd_1b_virtual", _bench_ssgd_virtual, mesh,

@@ -40,6 +40,13 @@ def load_newest_metrics(search_dir: str, path: str | None = None):
             continue
         if not isinstance(parsed, dict):
             continue
+        if path is None and parsed.get("backend") == "cpu":
+            # a CPU-fallback round (bench._run_cpu_fallback): honest
+            # degraded numbers, but NOT a reference the README claims
+            # or the perf tripwire should reconcile against — fall
+            # through to the newest real-backend artifact (an explicit
+            # --artifact path still loads it)
+            continue
         metrics = parsed.get("all_metrics")
         if not isinstance(metrics, dict):
             if isinstance(parsed.get("value"), (int, float)) \

@@ -72,7 +72,23 @@ CLAIMS = [
      r"int8 moves\s+\*\*([\d.]+?)× fewer\*\*", 1.0),
     ("ssgd_comm_topk_wire_reduction_vs_dense",
      r"topk \*\*([\d.]+?)× fewer\*\*", 1.0),
+    # round-11 measured-step-time pair: native int8 wire + overlap vs
+    # dense at the comm-bound geometry (bench comm_speedup phase /
+    # multichip dryrun), claimed as the >=1.0x acceptance form until a
+    # multi-shard real-backend round records the achieved factor
+    ("ssgd_comm_int8_step_speedup",
+     r"int8 runs \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
+    ("ssgd_comm_topk_step_speedup",
+     r"topk \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
 ]
+
+#: claims stated as FLOORS ("×+"): the measured value may exceed the
+#: claim by any margin (that is the feature working); only a measured
+#: value tolerance-below the floor fails
+FLOOR_CLAIMS = frozenset((
+    "ssgd_comm_int8_step_speedup",
+    "ssgd_comm_topk_step_speedup",
+))
 
 
 def _num(text: str) -> float:
@@ -141,7 +157,13 @@ def main(argv=None) -> int:
         ratio = claim / got
         line = (f"{metric}: claimed {claim:g} vs measured {got:g} "
                 f"(x{ratio:.2f})")
-        if abs(ratio - 1.0) > args.tolerance:
+        if metric in FLOOR_CLAIMS:
+            # one-sided: beating the floor is success, not drift
+            bad = got < claim * (1.0 - args.tolerance)
+            line += " [floor]"
+        else:
+            bad = abs(ratio - 1.0) > args.tolerance
+        if bad:
             failures.append("  FAIL " + line)
         else:
             ok.append("  ok   " + line)

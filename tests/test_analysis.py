@@ -511,6 +511,121 @@ def test_tda050_negative_comms_wrappers_and_scope():
     assert lint(raw, path=LIB) == []
 
 
+# ---------------------------------------------------------------- TDA051
+
+
+PARALLEL = "tpu_distalg/parallel/somecomms.py"
+
+
+def test_tda051_int32_psum_on_quantized_buffer_flagged():
+    """The exact PR 5 regression: the quantized (clip∘floor) buffer
+    widened to int32 AS IT ENTERS the psum — 4 bytes/elem on the wire
+    while the accounting claims 1."""
+    src = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def int8_sync(x, scale, u, axis):
+        q = jnp.clip(jnp.floor(x / scale + u), -127, 127)
+        s = lax.psum(q.astype(jnp.int32), axis)
+        return s.astype(jnp.float32) * scale
+    """
+    vs = lint(src, path=PARALLEL)
+    assert codes(vs) == ["TDA051"]
+    assert "int32" in vs[0].message
+
+
+def test_tda051_widened_int8_buffer_into_any_collective_flagged():
+    """Taint follows the buffer through renames/reshapes; every
+    collective in the wire-op set is policed (here: all_to_all, the
+    native ring's scatter phase)."""
+    src = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def scatter(x, scale, u, axis, n):
+        q = jnp.clip(jnp.floor(x / scale + u), -127, 127) \
+            .astype(jnp.int8)
+        q2 = q.reshape(n, -1)
+        return lax.all_to_all(q2.astype(jnp.float32), axis,
+                              split_axis=0, concat_axis=0)
+    """
+    assert codes(lint(src, path=PARALLEL)) == ["TDA051"]
+
+
+def test_tda051_nested_closure_flagged_exactly_once():
+    """A violation inside a nested def (the native ring's `exchange`
+    shape) is reported ONCE — the rule walks outermost functions and
+    recurses itself, so re-visiting the closure as its own root would
+    double-report and desync a --baseline file."""
+    src = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def outer(x, scale, u, axis):
+        def inner():
+            q = jnp.clip(jnp.floor(x / scale + u), -127, 127)
+            return lax.psum(q.astype(jnp.int32), axis)
+        return inner()
+    """
+    assert codes(lint(src, path=PARALLEL)) == ["TDA051"]
+
+
+def test_tda051_tuple_unpack_and_keyword_arg_flagged():
+    """Taint survives tuple-unpacking assignment, and collectives
+    called with the buffer as a KEYWORD argument are still policed —
+    the sibling unpacked name stays clean (element-wise pairing, no
+    over-taint)."""
+    src = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def sync(x, scale, u, axis):
+        q, s = jnp.clip(jnp.floor(x / scale + u), -127, 127), scale
+        wide = lax.psum(x=q.astype(jnp.int32), axis_name=axis)
+        fine = lax.psum(s.astype(jnp.float32), axis)
+        return wide, fine
+    """
+    assert codes(lint(src, path=PARALLEL)) == ["TDA051"]
+
+
+def test_tda051_negative_native_ring_and_scope():
+    """The native pattern is clean: int8 rides the collectives, the
+    int32 widening happens on the RECEIVED buffer (after the wire).
+    bf16 casts of unquantized data, and code outside parallel/, are
+    out of scope."""
+    native = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def int8_sync(x, scale, u, axis, n):
+        q = jnp.clip(jnp.floor(x / scale + u), -127, 127) \
+            .astype(jnp.int8)
+        recv = lax.all_to_all(q.reshape(n, -1), axis,
+                              split_axis=0, concat_axis=0)
+        s = jnp.sum(recv.astype(jnp.int32), axis=0)
+        return s.astype(jnp.float32) * (scale * n)
+    """
+    assert lint(native, path=PARALLEL) == []
+    bf16 = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def bf16_sync(x, axis):
+        return lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype)
+    """
+    assert lint(bf16, path=PARALLEL) == []
+    widened = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def int8_sync(x, scale, u, axis):
+        q = jnp.clip(jnp.floor(x / scale + u), -127, 127)
+        return lax.psum(q.astype(jnp.int32), axis)
+    """
+    assert lint(widened, path=LIB) == []  # parallel/ only
+
+
 # ------------------------------------------------- suppressions / TDA000
 
 

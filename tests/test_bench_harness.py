@@ -8,6 +8,9 @@ import json
 
 def test_backend_init_failure_emits_summary_and_fails(monkeypatch,
                                                       capsys):
+    """Backend dead AND the CPU fallback's own mesh build failing (the
+    same dead get_mesh) still leaves an honest zeroed summary + rc 2 —
+    the pre-fallback contract is the floor, never lost."""
     import bench
     from tpu_distalg import parallel
 
@@ -21,17 +24,127 @@ def test_backend_init_failure_emits_summary_and_fails(monkeypatch,
     monkeypatch.setattr(bench, "INIT_RETRY_ATTEMPTS", 3)
     monkeypatch.setattr(bench, "INIT_RETRY_SECONDS", 0)
     monkeypatch.setattr(bench, "_SUMMARY", {})
+    monkeypatch.setattr(bench, "_BACKEND_TAG", None)
 
     rc = bench.main([])
     assert rc == 2
-    assert calls["n"] == 3  # retried, then gave up
+    # 3 supervised init attempts, then the CPU fallback's own attempt
+    assert calls["n"] == 4
     out = capsys.readouterr()
     last = json.loads(out.out.strip().splitlines()[-1])
     # the driver-schema flagship line with the all-metrics map, zeroed
     assert last["metric"] == "ssgd_lr_steps_per_sec_per_chip"
     assert last["value"] == 0.0
     assert "all_metrics" in last
+    assert last["backend"] == "cpu"
     assert "backend init failed (attempt 3/3)" in out.err
+
+
+def test_cpu_fallback_tier_emits_full_metric_set(monkeypatch, capsys):
+    """The ROADMAP hygiene rider, unit-tested: with the backend down,
+    the CPU tier emits EVERY canonical metric line — measured on host
+    devices where feasible, skipped-with-zero where TPU-only — all
+    tagged ``backend: cpu``, and the summary carries the tag so
+    bench_artifacts will not serve this round as the claims/tripwire
+    reference."""
+    import bench
+
+    monkeypatch.setattr(bench, "_SUMMARY", {})
+    monkeypatch.setattr(bench, "_LINES", [])
+    monkeypatch.setattr(bench, "_BACKEND_TAG", None)
+
+    rc = bench._run_cpu_fallback("UNAVAILABLE (test)", fast=True)
+    assert rc == 2
+    out = capsys.readouterr().out
+    lines = [json.loads(ln) for ln in out.strip().splitlines()]
+    by_metric = {}
+    for ln in lines[:-1]:
+        by_metric.setdefault(ln["metric"], ln)
+    # the full canonical metric set, no round is ever blank again
+    missing = [n for n in bench.ALL_METRIC_NAMES if n not in by_metric]
+    assert not missing, missing
+    assert all(ln.get("backend") == "cpu" for ln in lines[:-1])
+    # measured-where-feasible: the flagship and the comm lines carry
+    # real nonzero values; TPU-only lines are explicit skips
+    assert by_metric["ssgd_lr_steps_per_sec_per_chip"]["value"] > 0
+    assert by_metric["ssgd_comm_int8_bytes_wire_per_sync"]["value"] > 0
+    assert by_metric["ssgd_comm_int8_step_speedup"]["value"] > 0
+    assert "skipped" in by_metric[
+        "ring_attention_128k_tokens_per_sec_per_chip"]
+    # the summary line is tagged and regression-free
+    last = lines[-1]
+    assert last["backend"] == "cpu"
+    assert "all_metrics" in last and "regressions" not in last
+    assert set(bench.ALL_METRIC_NAMES) <= set(last["all_metrics"])
+
+
+def test_all_metric_names_match_emission_sites():
+    """ALL_METRIC_NAMES is the CPU-fallback tier's contract, but the
+    real emissions live in the phase functions — tie the two together
+    statically so a rename/addition in either place fails loudly
+    instead of rotting into stale skipped-with-zero lines (the exact
+    drift the hygiene rider exists to prevent). Every canonical name
+    must match a ``"metric": ...`` emission site in bench.py (literal
+    or f-string family), and every literal emission must be canonical."""
+    import ast
+    import re
+    from pathlib import Path
+
+    import bench
+
+    tree = ast.parse(Path(bench.__file__).read_text())
+    literals: set = set()
+    templates = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and k.value == "metric"):
+                continue
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                literals.add(v.value)
+            elif isinstance(v, ast.JoinedStr):
+                pat = "".join(
+                    re.escape(p.value)
+                    if isinstance(p, ast.Constant) else ".+"
+                    for p in v.values)
+                templates.append(re.compile(f"^{pat}$"))
+    # ALL_METRIC_NAMES itself is a tuple of constants, not emission
+    # dicts, so it never self-satisfies this check
+    unemitted = [
+        n for n in bench.ALL_METRIC_NAMES
+        if n not in literals and not any(t.match(n) for t in templates)]
+    assert not unemitted, (
+        f"canonical metrics with no emission site in bench.py "
+        f"(renamed phase metric without updating ALL_METRIC_NAMES?): "
+        f"{unemitted}")
+    rogue = sorted(literals - set(bench.ALL_METRIC_NAMES))
+    assert not rogue, (
+        f"metric emissions missing from ALL_METRIC_NAMES (the CPU "
+        f"fallback would leave these blank on a dead-backend round): "
+        f"{rogue}")
+
+
+def test_artifact_loader_skips_cpu_fallback_rounds(tmp_path):
+    """A cpu-tagged artifact must not become the README-claims /
+    tripwire reference — the loader falls through to the newest real
+    round."""
+    import json as _json
+
+    import bench_artifacts
+
+    (tmp_path / "BENCH_r08.json").write_text(_json.dumps(
+        {"parsed": {"backend": "cpu",
+                    "all_metrics": {"x": 1.0}}}))
+    (tmp_path / "BENCH_r07.json").write_text(_json.dumps(
+        {"parsed": {"all_metrics": {"x": 5.0}}}))
+    ref, metrics = bench_artifacts.load_newest_metrics(str(tmp_path))
+    assert ref == "BENCH_r07.json"
+    assert metrics == {"x": 5.0}
+    # an explicit --artifact path still loads the cpu round
+    ref, metrics = bench_artifacts.load_newest_metrics(
+        str(tmp_path), str(tmp_path / "BENCH_r08.json"))
+    assert ref == "BENCH_r08.json" and metrics == {"x": 1.0}
 
 
 def test_summary_preserves_recorded_metrics():

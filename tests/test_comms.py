@@ -199,6 +199,126 @@ def test_comm_spec_parse_and_errors():
         comms.CommSpec.parse("topk:0")
 
 
+def test_comm_spec_overlap_spellings():
+    """Overlap is ON by default; '@seq' spells the sequential A/B, and
+    'int8:seed:bucket' sets the overlap-bucket granularity."""
+    assert comms.CommSpec.parse("int8").overlap is True
+    assert comms.CommSpec.parse("int8@seq").overlap is False
+    assert comms.CommSpec.parse("bucketed:64@seq").bucket_elems == 64
+    assert comms.CommSpec.parse("bucketed:64@seq").overlap is False
+    assert comms.CommSpec.parse("topk:0.05@seq").topk_fraction == 0.05
+    assert comms.CommSpec.parse("int8@ov").overlap is True
+    spec = comms.CommSpec.parse("int8:9:128")
+    assert spec.seed == 9 and spec.bucket_elems == 128
+    with pytest.raises(ValueError, match="unknown comm schedule"):
+        comms.CommSpec.parse("int8seq")
+
+
+# ------------------------------------------------------------- overlap
+
+
+@pytest.mark.parametrize("ov,seq", [
+    ("bucketed", "bucketed@seq"),
+    ("bucketed:64", "bucketed:64@seq"),       # multi-bucket f32 ring
+    ("int8", "int8@seq"),
+    ("int8:0:64", "int8:0:64@seq"),           # multi-bucket int8 ring
+    ("topk:0.1", "topk:0.1@seq"),
+])
+def test_overlap_bitwise_equals_sequential(mesh4, ov, seq):
+    """The double-buffered pipeline is a SCHEDULING change only: per
+    comm spec, overlapped and sequential runs produce bitwise-identical
+    sums and residuals (the per-bucket math is the same composition in
+    both orders) — 257 elems so the multi-bucket cases carry an odd
+    remainder through the padding path."""
+    rng = np.random.default_rng(11)
+    gs = rng.normal(size=(4, 257)).astype(np.float32)
+    cnts = np.ones(4, np.float32)
+    a, ca, ra = _reduce_on_mesh(mesh4, ov, gs, cnts, t=5)
+    b, cb, rb = _reduce_on_mesh(mesh4, seq, gs, cnts, t=5)
+    np.testing.assert_array_equal(a, b)
+    assert ca == cb
+    np.testing.assert_array_equal(ra, rb)
+
+
+def test_int8_multi_bucket_odd_remainder_sums(mesh8):
+    """Native int8 ring at a deliberately awkward shape: 257 elems over
+    8 shards with 64-elem buckets (5 buckets, last one mostly padding)
+    still lands in the two-stage quantization band and keeps the count
+    leaf exact."""
+    rng = np.random.default_rng(12)
+    gs = rng.normal(size=(8, 257)).astype(np.float32)
+    cnts = np.arange(1.0, 9.0, dtype=np.float32)
+    want = gs.sum(axis=0)
+    got, cnt, _ = _reduce_on_mesh(mesh8, "int8:0:64", gs, cnts)
+    assert cnt == float(cnts.sum())
+    scale = float(np.abs(gs).max())
+    # two seeded stochastic roundings at 1/127 granularity each, n=8:
+    # per-element error bound ~ 2·n·(max/127)
+    np.testing.assert_allclose(got, want, atol=2 * 8 * scale / 127)
+
+
+def test_reduce_compute_thunk_rides_the_sync(mesh4):
+    """`reduce(..., compute=thunk)` returns the thunk's value as aux
+    and leaves the reduction bitwise-unchanged — the overlap window is
+    free to hide trainer math without touching numerics."""
+    rng = np.random.default_rng(13)
+    gs = rng.normal(size=(4, 64)).astype(np.float32)
+    for sched in ("dense", "int8", "bucketed:16", "topk:0.1"):
+        sync = comms.make_sync(sched, mesh4,
+                               jax.ShapeDtypeStruct((64,), jnp.float32))
+
+        def plain(g, res, t):
+            out, _ = sync.reduce(g[0], res, t)
+            return out
+
+        def with_thunk(g, res, t):
+            out, _, aux = sync.reduce(g[0], res, t,
+                                      compute=lambda: g[0] * 3.0)
+            return out, aux[None, :]
+
+        specs = (P("data", None), P("data", None), P())
+        res = jax.device_put(jnp.asarray(sync.init_state()),
+                             NamedSharding(mesh4, P("data", None)))
+        g_sh = jax.device_put(gs, NamedSharding(mesh4, P("data", None)))
+        f0 = data_parallel(plain, mesh4, in_specs=specs, out_specs=P())
+        f1 = data_parallel(with_thunk, mesh4, in_specs=specs,
+                           out_specs=(P(), P("data", None)))
+        want = np.asarray(jax.jit(f0)(g_sh, res, jnp.int32(2)))
+        got, aux = jax.jit(f1)(g_sh, res, jnp.int32(2))
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=sched)
+        np.testing.assert_array_equal(np.asarray(aux), gs * 3.0)
+
+
+def test_sparse_allreduce_public_api(mesh4):
+    """The generalized sparse-vector combine (usable beyond gradients):
+    per-shard (value, index) pairs — duplicates included — sum into the
+    dense vector, replicated bitwise-identically on every shard."""
+    length, k = 40, 3
+    idx = np.array([[0, 5, 5], [1, 5, 39], [2, 0, 7], [39, 39, 3]],
+                   np.int32)
+    vals = np.arange(12, dtype=np.float32).reshape(4, k) + 1.0
+    want = np.zeros(length, np.float32)
+    for s in range(4):
+        for j in range(k):
+            want[idx[s, j]] += vals[s, j]
+
+    def body(v, i):
+        out = comms.sparse_allreduce(v[0], i[0], length)
+        return out[None, :]
+
+    fn = data_parallel(
+        body, mesh4,
+        in_specs=(P("data", None), P("data", None)),
+        out_specs=P("data", None))
+    rows = np.asarray(jax.jit(fn)(
+        jax.device_put(vals, NamedSharding(mesh4, P("data", None))),
+        jax.device_put(idx, NamedSharding(mesh4, P("data", None)))))
+    for s in range(4):
+        np.testing.assert_allclose(rows[s], want, atol=1e-6)
+    np.testing.assert_array_equal(rows[0], rows[1])
+    np.testing.assert_array_equal(rows[0], rows[3])
+
+
 def test_sync_stats_wire_reductions(mesh8):
     """The acceptance floor of the bench comparison lines: at the
     benchmark gradient width, int8 moves >=3x fewer wire bytes than
@@ -393,6 +513,40 @@ def test_local_sgd_comm_segmented_checkpoint(mesh4, cancer_data,
                                   np.asarray(seg.ws))
 
 
+def test_int8_overlap_segmented_checkpoint(mesh4, cancer_data,
+                                           tmp_path):
+    """Resume mid-schedule under the OVERLAPPED multi-bucket native
+    int8 ring (d=31 over 16-elem buckets → 2 in-flight buckets per
+    sync): the pipeline drains inside every sync and the rounding keys
+    fold the absolute step id, so segmented == straight BITWISE — the
+    in-flight bucket state never leaks across the checkpoint boundary
+    and the stochastic rounding replays exactly."""
+    cfg = ssgd.SSGDConfig(n_iterations=20, comm="int8:3:16")
+    straight = ssgd.train(*cancer_data, mesh4, cfg)
+    seg = ssgd.train(*cancer_data, mesh4, cfg,
+                     checkpoint_dir=str(tmp_path / "ssgd_int8"),
+                     checkpoint_every=7)
+    np.testing.assert_array_equal(np.asarray(straight.w),
+                                  np.asarray(seg.w))
+    np.testing.assert_array_equal(np.asarray(straight.accs),
+                                  np.asarray(seg.accs))
+
+
+def test_topk_overlap_vs_seq_full_trainer(mesh4, cancer_data):
+    """Trainer-level A/B of the overlap knob: a full topk run with the
+    pipeline on equals the @seq run bit for bit (weights, accs) —
+    overlap buys schedule, never numerics, through the whole EF-residual
+    carry chain."""
+    a = ssgd.train(*cancer_data, mesh4,
+                   ssgd.SSGDConfig(n_iterations=15, comm="topk:0.05"))
+    b = ssgd.train(*cancer_data, mesh4,
+                   ssgd.SSGDConfig(n_iterations=15,
+                                   comm="topk:0.05@seq"))
+    np.testing.assert_array_equal(np.asarray(a.w), np.asarray(b.w))
+    np.testing.assert_array_equal(np.asarray(a.accs),
+                                  np.asarray(b.accs))
+
+
 def test_lr_comm_segmented_checkpoint(mesh4, cancer_data, tmp_path):
     cfg = lr.LRConfig(n_iterations=10, comm="int8")
     straight = lr.train(*cancer_data, mesh4, cfg)
@@ -426,3 +580,24 @@ def test_comm_counters_emitted(mesh4, cancer_data, tmp_path):
         "comm.bytes_logical"]
     rendered = treport.render(summary)
     assert "comm:" in rendered and "compression" in rendered
+
+
+def test_overlap_counters_render_efficiency_line(tmp_path):
+    """comm.overlap_hidden_ms / comm.sync_ms (bumped by the bench's
+    seq-vs-overlap calibration via comms.emit_overlap_counters) render
+    as the tda report overlap-efficiency line: fraction of comm time
+    hidden behind compute."""
+    from tpu_distalg import telemetry
+    from tpu_distalg.telemetry import report as treport
+
+    telemetry.configure(str(tmp_path))
+    try:
+        comms.emit_overlap_counters(hidden_ms=300.4, comm_ms=100.2)
+    finally:
+        telemetry.configure(False)
+    summary = treport.summarize(treport.load_events(str(tmp_path)))
+    assert summary["counters"]["comm.overlap_hidden_ms"] == 300
+    assert summary["counters"]["comm.sync_ms"] == 100
+    rendered = treport.render(summary)
+    assert "comm overlap: 300 ms hidden behind compute" in rendered
+    assert "75% of 400 ms comm time" in rendered

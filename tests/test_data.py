@@ -427,3 +427,35 @@ def test_readme_claims_checker(tmp_path):
     with open(os.path.join(here, "README.md")) as f:
         claims = crc.extract_claims(f.read())
     assert len(claims) >= 10
+    # the round-11 step-speedup pair registers (acceptance-floor form)
+    assert claims["ssgd_comm_int8_step_speedup"] == 1.0
+    assert claims["ssgd_comm_topk_step_speedup"] == 1.0
+
+
+def test_readme_claims_floor_semantics(tmp_path):
+    """FLOOR_CLAIMS are one-sided: a measured speedup far ABOVE the
+    claimed '1.0x+' floor is the feature working (must pass), while a
+    measured value tolerance-below the floor still fails — review
+    finding: a two-sided drift check would fail exactly when the
+    comm-bound win lands."""
+    sys.path.insert(0, str(os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "scripts")))
+    try:
+        import check_readme_claims as crc
+    finally:
+        sys.path.pop(0)
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "int8 runs **1.0×+** the dense step rate and "
+        "topk **1.0×+** the dense step rate\n")
+    art = tmp_path / "BENCH_r07.json"
+    art.write_text(json.dumps({"parsed": {
+        "metric": "ssgd_comm_int8_step_speedup", "value": 2.6,
+        "all_metrics": {"ssgd_comm_int8_step_speedup": 2.6,
+                        "ssgd_comm_topk_step_speedup": 1.9}}}))
+    assert crc.main(["--readme", str(readme)]) == 0  # beats the floor
+    art.write_text(json.dumps({"parsed": {
+        "metric": "ssgd_comm_int8_step_speedup", "value": 0.3,
+        "all_metrics": {"ssgd_comm_int8_step_speedup": 0.3,
+                        "ssgd_comm_topk_step_speedup": 1.1}}}))
+    assert crc.main(["--readme", str(readme)]) == 1  # under the floor

@@ -22,6 +22,10 @@ TDA041      statically-sized resident blocks fit the VMEM budget
 TDA050      no raw ``lax.psum``-family collectives in
             ``tpu_distalg/models/`` — gradient traffic stays behind
             the instrumented comms layer (``parallel/comms.py``, PR 5)
+TDA051      no dtype-widening cast on a quantized buffer as it enters
+            a collective in ``tpu_distalg/parallel/`` — compressed
+            payloads ride the wire natively (the int32-psum wire
+            PR 5 documented and round 11 removed stays removed)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason

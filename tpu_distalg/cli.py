@@ -41,11 +41,15 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
             "--comm", default="dense", metavar="SCHED",
             help="cross-shard sync schedule: dense (bitwise the "
                  "classic psum — default), bucketed[:elems] "
-                 "(ppermute-chunk ring, overlapped buckets), "
-                 "hier[:groups] (reduce-scatter intra-group / ring "
-                 "across groups / all-gather), bf16, int8[:seed] "
-                 "(seeded stochastic rounding), topk[:frac] "
-                 "(sparsified + error feedback). Emits "
+                 "(ppermute-chunk ring), hier[:groups] "
+                 "(reduce-scatter intra-group / ring across groups / "
+                 "all-gather), bf16, int8[:seed[:bucket]] (native "
+                 "int8 wire: seeded stochastic rounding, int8 in both "
+                 "ring phases), topk[:frac] (sparse allreduce + error "
+                 "feedback). bucketed/int8 overlap their bucket "
+                 "exchange with compute by default; append @seq for "
+                 "the bitwise-identical sequential exchange (a no-op "
+                 "for the single-bucket topk/hier). Emits "
                  "comm.bytes_wire/bytes_logical/rounds telemetry "
                  "counters per run")
     if frac is not None:

@@ -5,6 +5,11 @@ MA plus a block-level momentum filter on the averaged update:
 (``/root/reference/optimization/bmuf.py:113-114``, μ=0.9 ζ=0.1 ``:24-25``).
 ``delta_w`` starts *random* like the reference (``bmuf.py:95``) unless
 ``random_delta_init=False``.
+
+Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
+``comm='int8'``/``'topk'``/... compresses the round-end average on the
+native wire, with the bucket-overlap pipeline on by default (``@seq``
+disables — bitwise-identical).
 """
 
 from __future__ import annotations

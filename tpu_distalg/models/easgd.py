@@ -5,6 +5,11 @@ takes one elastic step ``w_i ← w_i − η·ḡ − α(w_i − w)`` (``/root/re
 optimization/easgd.py:41-45``) with α = η·ρ (``:24``), and the center blends
 ``w ← (1−β)·w + β·mean(w_i)`` with β = n_replicas·α (``:25,106``). β is
 derived from the actual mesh size at build time unless overridden.
+
+Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
+``comm='int8'``/``'topk'``/... compresses the round-end blend's average
+on the native wire, with the bucket-overlap pipeline on by default
+(``@seq`` disables — bitwise-identical).
 """
 
 from __future__ import annotations

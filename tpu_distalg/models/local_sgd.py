@@ -77,9 +77,13 @@ class LocalSGDConfig:
     shuffle_seed: int | None = None
     # round-combine sync schedule (parallel/comms.py): 'dense' (bitwise
     # the pre-comms pmean — the default), 'bucketed', 'hier', 'bf16',
-    # 'int8', 'topk[:frac]' (error-feedback residuals in the scan
-    # state). The ONE collective of this family is the round-end model
-    # average, so every sampler (megakernel included) composes with it.
+    # 'int8' (native int8 wire), 'topk[:frac]' (error-feedback
+    # residuals in the scan state). bucketed/int8 run the
+    # double-buffered bucket overlap pipeline by default ('@seq'
+    # disables — bitwise-identical either way; a no-op for the
+    # single-bucket topk/hier). The ONE collective of
+    # this family is the round-end model average, so every sampler
+    # (megakernel included) composes with it.
     comm: str = "dense"
 
 

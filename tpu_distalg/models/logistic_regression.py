@@ -29,8 +29,10 @@ class LRConfig:
     seed: int = 42
     init_seed: int = 7
     # gradient-sync schedule (parallel/comms.py): 'dense' (bitwise the
-    # pre-comms psum), 'bucketed', 'hier', 'bf16', 'int8',
-    # 'topk[:frac]' (error-feedback residuals in the scan state)
+    # pre-comms psum), 'bucketed', 'hier', 'bf16', 'int8' (native int8
+    # wire), 'topk[:frac]' (error-feedback residuals in the scan
+    # state); bucketed/int8 overlap their bucket exchange by
+    # default ('@seq' for the bitwise-identical sequential reference)
     comm: str = "dense"
 
 

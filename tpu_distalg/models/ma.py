@@ -3,6 +3,11 @@
 Thin preset over the shared local-update harness; semantics of
 ``/root/reference/optimization/ma.py`` (300 rounds × 5 local steps, plain
 average combine, resync each round).
+
+Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
+``comm='int8'``/``'topk'``/... compresses the round-end average on the
+native wire, with the bucket-overlap pipeline on by default (``@seq``
+disables — bitwise-identical).
 """
 
 from __future__ import annotations

@@ -90,14 +90,20 @@ class SSGDConfig:
     # contraction psums over 'data' only, and w lives sharded P('model')
     feature_sharded: bool = False
     # gradient-sync schedule (parallel/comms.py): 'dense' (bitwise the
-    # pre-comms psum — the default), 'bucketed' (ppermute-chunk ring,
-    # overlapped bucket by bucket), 'hier' (reduce-scatter intra-group /
-    # ring across groups / all-gather), 'bf16', 'int8' (seeded
-    # stochastic rounding), 'topk[:frac]' (sparsified with
-    # error-feedback residuals carried in the scan state). Composes
-    # with samplers 'bernoulli', 'fused' and 'fused_gather'; the
-    # megakernel ('fused_train': no per-step collective exists to
-    # compress), 'fixed' and feature_sharded reject non-dense comm.
+    # pre-comms psum — the default), 'bucketed' (ppermute-chunk ring),
+    # 'hier' (reduce-scatter intra-group / ring across groups /
+    # all-gather), 'bf16', 'int8' (NATIVE int8 ring: seeded stochastic
+    # rounding, int8 on the wire in both phases), 'topk[:frac]'
+    # (sparse_allreduce with error-feedback residuals carried in the
+    # scan state). bucketed/int8 run the double-buffered bucket
+    # OVERLAP pipeline by default — the exchange of bucket b hides
+    # behind bucket b−1's unpack and the reg-gradient math; append
+    # '@seq' (e.g. 'int8@seq') for the sequential A/B reference
+    # (bitwise-identical, slower; a no-op for the single-bucket
+    # topk/hier). Composes with samplers 'bernoulli',
+    # 'fused' and 'fused_gather'; the megakernel ('fused_train': no
+    # per-step collective exists to compress), 'fixed' and
+    # feature_sharded reject non-dense comm.
     comm: str = "dense"
 
 
@@ -127,10 +133,14 @@ def _comm_sync(mesh, config, d: int):
 def _build_scan_comm(config: SSGDConfig, sample_and_grad, prep_xs=None):
     """Comm-schedule variant of :func:`_build_scan`:
     ``sample_and_grad(X, y, valid, w, payload, t, res)`` → (Σ grad,
-    count, res'); the flat error-feedback residual rides in the scan
-    carry (zero-width for stateless schedules) and is returned so
+    count, res', reg); the flat error-feedback residual rides in the
+    scan carry (zero-width for stateless schedules) and is returned so
     checkpointed runs can persist it — a dropped residual would silently
-    void the top-k convergence correction."""
+    void the top-k convergence correction. ``reg`` is the
+    regularization gradient, computed INSIDE the sync's overlap window
+    (``sync.reduce(..., compute=...)``): it is the step's one piece of
+    update math independent of the reduced gradient, so the comm layer
+    schedules the exchange's wire time behind it."""
     if config.eval_every < 1:
         raise ValueError(
             f"eval_every must be >= 1, got {config.eval_every}"
@@ -143,12 +153,9 @@ def _build_scan_comm(config: SSGDConfig, sample_and_grad, prep_xs=None):
         def step(carry, x):
             w, last_acc, res = carry
             t, payload = x
-            g, cnt, res = sample_and_grad(
+            g, cnt, res, reg = sample_and_grad(
                 X, y, valid, w, payload, t, res)
             n_batch = jnp.maximum(cnt, 1.0)  # guard empty sample
-            reg = logistic.reg_gradient(
-                w, config.reg_type, config.elastic_alpha
-            )
             w = w - config.eta * (g / n_batch + config.lam * reg)
             if config.eval_test and config.eval_every == 1:
                 acc = metrics.binary_accuracy(X_test @ w, y_test)
@@ -334,15 +341,21 @@ def _make_train_fn_comm(mesh: Mesh, config: SSGDConfig, n_padded: int,
 
     def _local_grad(X, y, mask, w, t, res):
         g, cnt = logistic.grad_sum(X, y, w, mask)
-        (g, cnt), res = sync.reduce((g, cnt), res, t)
-        return g, cnt, res
+        # the reg gradient is the update's one sync-independent term —
+        # handing it to the comm layer as the overlap thunk lets the
+        # scheduler hide the exchange behind it
+        (g, cnt), res, reg = sync.reduce(
+            (g, cnt), res, t,
+            compute=lambda: logistic.reg_gradient(
+                w, config.reg_type, config.elastic_alpha))
+        return g, cnt, res, reg
 
     grad_fn = data_parallel(
         _local_grad,
         mesh,
         in_specs=(P("data", None), P("data"), P("data"), P(), P(),
                   P("data", None)),
-        out_specs=(P(), P(), P("data", None)),
+        out_specs=(P(), P(), P("data", None), P()),
     )
     key = prng.root_key(config.seed)
 
@@ -500,8 +513,11 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
                     idx_shards, shard, keepdims=False
                 )
                 g, cnt = kern(X2, w, idx)
-                (g, cnt), res = sync.reduce((g * col_keep, cnt), res, t)
-                return g, cnt, res
+                (g, cnt), res, reg = sync.reduce(
+                    (g * col_keep, cnt), res, t,
+                    compute=lambda: logistic.reg_gradient(
+                        w, config.reg_type, config.elastic_alpha))
+                return g, cnt, res, reg
         else:
             def _local_grad(X2, w, idx_shards):
                 shard = lax.axis_index(DATA_AXIS)
@@ -528,8 +544,11 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
             def _local_grad(X2, w, t_payload, t, res):
                 shard = lax.axis_index(DATA_AXIS)
                 g, cnt = kern(X2, w, t_payload + config.seed, shard)
-                (g, cnt), res = sync.reduce((g * col_keep, cnt), res, t)
-                return g, cnt, res
+                (g, cnt), res, reg = sync.reduce(
+                    (g * col_keep, cnt), res, t,
+                    compute=lambda: logistic.reg_gradient(
+                        w, config.reg_type, config.elastic_alpha))
+                return g, cnt, res, reg
         else:
             def _local_grad(X2, w, t):
                 shard = lax.axis_index(DATA_AXIS)
@@ -542,7 +561,7 @@ def make_train_fn_fused(mesh: Mesh, config: SSGDConfig, meta: dict):
             mesh,
             in_specs=(P("data", None), P(), P(), P(),
                       P("data", None)),
-            out_specs=(P(), P(), P("data", None)),
+            out_specs=(P(), P(), P("data", None), P()),
         )
 
         def sample_and_grad(X2, y, valid, w, x, t, res):

@@ -30,18 +30,50 @@ order, counter-based PRNG only):
                 devices) or from ``hier_groups``.
   ``bf16``      cast to bfloat16 on the wire, one psum, cast back —
                 half the bytes, the standard gradient-compression
-                baseline.
-  ``int8``      seeded STOCHASTIC rounding to int8 against a pmax-shared
-                scale, integer psum, dequantize — ~4x fewer wire bytes,
-                unbiased in expectation, bitwise-replayable because the
-                rounding noise is threefry(seed, step, shard).
+                baseline (a bf16 psum really moves bf16).
+  ``int8``      the NATIVE compressed ring (round 11 closed PR 5's
+                int32-psum caveat): per bucket, seeded STOCHASTIC
+                rounding to int8 against a pmax-shared scale, an
+                ``all_to_all`` chunk scatter that puts int8 on the
+                wire, EXACT int32 accumulation of the integer
+                contributions at the chunk owner (order-free, so
+                deterministic for free), a second seeded stochastic
+                requantization of the reduced chunk (scale ``n·s`` —
+                the integer sum is bounded by ``127·n``), and an int8
+                ppermute ring all-gather. Both phases move int8, so
+                the ~4x wire reduction is ON the wire, not in the
+                accounting. Unbiased in expectation,
+                bitwise-replayable: rounding noise is
+                threefry(seed, step, shard, bucket·stage).
   ``topk``      top-k sparsification with ERROR FEEDBACK: each shard
                 keeps the k largest-|.| entries of (gradient +
-                residual), all-reduces only those, and carries the
+                residual), combines only those via
+                :func:`sparse_allreduce` (the generalized ring
+                all-gather of (value, index) pairs), and carries the
                 unsent remainder in the scan state so nothing is ever
                 lost — the sparse-allreduce construction of
                 arXiv:1312.3020 with the EF-SGD residual correction
                 that preserves convergence.
+
+Overlap (round 11): the bucketed flat-vector schedules (``bucketed``,
+``int8``) run their buckets through a DOUBLE-BUFFERED software
+pipeline — the collective chain of bucket *b* is launched while bucket
+*b−1*'s unpack/dequantize compute finishes, so XLA's latency-hiding
+scheduler can hide the wire time behind the math instead of running
+them back to back (cf. the chunked, portable collective schedules of
+arXiv:2112.01075). On by default; spell ``<schedule>@seq`` to force the
+sequential exchange (the pipeline and the sequential loop are
+bitwise-identical — same per-bucket math, different interleaving — so
+``@seq`` exists for A/B timing, not for correctness). ``hier`` rides
+the same code path but always as ONE bucket, and ``topk``'s pair
+exchange is its own single in-flight buffer — ``@seq`` is accepted on
+both and is a no-op by construction. ``reduce`` also
+takes a ``compute=`` thunk of trainer math that is independent of the
+sync (e.g. the regularization gradient); it is evaluated next to the
+first in-flight bucket so the scheduler can hide the exchange behind
+it. The pipeline drains inside every sync, so the only cross-step comm
+state remains the error-feedback residual — which rides the scan carry
+and the checkpoint exactly as before (a resume mid-schedule is bitwise).
 
 Compression applies to float leaves with more than one element; scalars
 and integer leaves (step counts, minibatch counts) always go dense — a
@@ -113,14 +145,20 @@ class CommSpec:
     ``parse`` accepts the CLI spelling: a schedule name with an
     optional ``:arg`` — ``topk:0.01`` (kept fraction), ``bucketed:65536``
     (elements per bucket), ``hier:2`` (group count; 0 = infer from the
-    mesh topology), ``int8:7`` (stochastic-rounding seed).
+    mesh topology), ``int8:7`` (stochastic-rounding seed;
+    ``int8:7:4096`` also sets the overlap-bucket element count) — plus
+    an optional ``@seq`` suffix that disables the double-buffered
+    bucket-overlap pipeline (``int8@seq``, ``topk:0.05@seq``).
+    Overlapped and sequential schedules are bitwise-identical; ``@seq``
+    is the A/B-timing spelling.
     """
 
     schedule: str = "dense"
-    bucket_elems: int = 1 << 16      # 'bucketed': elements per bucket
+    bucket_elems: int = 1 << 16      # 'bucketed'/'int8': elems/bucket
     topk_fraction: float = 0.01      # 'topk': fraction of entries kept
     hier_groups: int = 0             # 'hier': 0 = infer from topology
     seed: int = 0                    # 'int8': stochastic-rounding seed
+    overlap: bool = True             # double-buffered bucket pipeline
 
     def __post_init__(self):
         if self.schedule not in SCHEDULES:
@@ -141,8 +179,13 @@ class CommSpec:
             return text
         if not text:
             return cls()
-        name, _, arg = str(text).partition(":")
+        text = str(text)
         kw = {}
+        if text.endswith("@seq"):
+            text, kw["overlap"] = text[: -len("@seq")], False
+        elif text.endswith("@ov"):
+            text = text[: -len("@ov")]  # explicit spelling of default
+        name, _, arg = text.partition(":")
         if arg:
             if name == "topk":
                 kw["topk_fraction"] = float(arg)
@@ -151,7 +194,10 @@ class CommSpec:
             elif name == "hier":
                 kw["hier_groups"] = int(arg)
             elif name == "int8":
-                kw["seed"] = int(arg)
+                seed, _, bucket = arg.partition(":")
+                kw["seed"] = int(seed)
+                if bucket:
+                    kw["bucket_elems"] = int(bucket)
             else:
                 raise ValueError(
                     f"comm schedule {name!r} takes no argument "
@@ -192,6 +238,42 @@ def _eligible(leaf) -> bool:
 
 def _ring_perm(n: int):
     return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_allgather(buf, axis_name: str, n: int):
+    """Origin-placed ring all-gather of one per-shard buffer (or a
+    pytree of them): ``n−1`` ``ppermute`` hops of ``buf``-sized
+    messages (the wire carries each leaf's own dtype); each leaf comes
+    back ``(n, *leaf.shape)`` with row *j* = shard *j*'s buffer,
+    bitwise-identical on every shard. All leaves hop inside the SAME
+    fori_loop, so a pair exchange (topk's value+index buffers) pays
+    ``n−1`` hop latencies, not ``2(n−1)`` back-to-back loops. The ONE
+    ring-gather implementation — the sparse pair exchange and the
+    native int8 ring both ride it, so a hop-ordering fix can never
+    land in one and not the other (the bug class PR 5's review caught
+    in the hier schedule)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    my = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    acc0 = jax.tree.map(
+        lambda b: lax.dynamic_update_index_in_dim(
+            jnp.zeros((n,) + b.shape, b.dtype), b, my, 0), buf)
+
+    def hop(s, carry):
+        b, acc = carry
+        b = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), b)
+        src = (my - s - 1) % n
+        acc = jax.tree.map(
+            lambda a, x: lax.dynamic_update_index_in_dim(a, x, src, 0),
+            acc, b)
+        return b, acc
+
+    _, acc = lax.fori_loop(0, n - 1, hop, (buf, acc0))
+    return acc
 
 
 def _ring_allreduce(v, axis_name: str, n: int):
@@ -320,6 +402,91 @@ def _hier_allreduce(v, axis_name: str, n: int, g: int):
     return out.reshape(-1)
 
 
+def sparse_allreduce(vals, idx, length: int, *,
+                     axis_name: str = DATA_AXIS, n: int | None = None):
+    """Sparse-vector allreduce: every shard contributes ``k`` (value,
+    index) pairs; returns the dense ``(length,)`` f32 sum, replicated
+    bitwise-identically on every shard.
+
+    The exchange is a ring all-gather of the pair buffers — ``n−1``
+    ``ppermute`` hops of ``8k`` bytes each, so the bytes crossing the
+    interconnect are exactly the sparse payload (a psum of a
+    zero-padded dense vector would move full-length f32). Every shard
+    then scatter-accumulates the ``n`` contributions in ORIGIN order
+    (shard 0 first): float addition is not associative, and per-shard
+    arrival order would silently de-replicate the result — this is the
+    replicated-output contract psum gives for free, earned without
+    psum.
+
+    Generalized out of the top-k gradient schedule (PR 5) so any sparse
+    combine can ride it — e.g. power-law rank deltas in graph workloads
+    (arXiv:1312.3020 is explicitly about power-law data). Duplicate
+    indices within one shard's contribution accumulate additively.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n is None:
+        from tpu_distalg.parallel.compat import axis_size
+
+        n = axis_size(axis_name)
+    if n == 1:
+        return jnp.zeros((length,), vals.dtype).at[idx].add(vals)
+    all_v, all_i = _ring_allgather((vals, idx), axis_name, n)
+    return lax.fori_loop(
+        0, n,
+        lambda j, out: out.at[all_i[j]].add(all_v[j]),
+        jnp.zeros((length,), vals.dtype))
+
+
+def _pipelined_buckets(buckets, exchange, finish, overlap: bool,
+                       compute=None):
+    """Run ``finish(exchange(bucket_i, i))`` over every bucket.
+
+    ``overlap=True`` is the double-buffered schedule: the scan carry
+    holds the in-flight (exchanged-but-unfinished) bucket, so iteration
+    *i* launches bucket *i*'s collective chain with no data dependence
+    on bucket *i−1*'s ``finish`` compute — XLA's latency-hiding
+    scheduler overlaps the two. ``overlap=False`` chains them
+    (exchange → finish per bucket). Both orders evaluate the identical
+    per-bucket composition, so the outputs are BITWISE equal — the
+    pipeline buys wall-clock, never numerics. ``compute`` (optional
+    thunk of sync-independent caller math) is evaluated next to the
+    first in-flight bucket and its result returned alongside, giving
+    the scheduler trainer compute to hide the first exchange behind.
+    Returns ``(stacked_outputs, aux)``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    nb = buckets.shape[0]
+    idx = jnp.arange(nb)
+    if not overlap:
+        aux = compute() if compute is not None else None
+
+        def one(_, x):
+            b, i = x
+            return None, finish(exchange(b, i))
+
+        _, out = lax.scan(one, None, (buckets, idx))
+        return out, aux
+
+    inflight = exchange(buckets[0], idx[0])
+    # evaluated AFTER the first exchange is in flight and independent
+    # of it — the scheduler may run it under the collective's latency
+    aux = compute() if compute is not None else None
+
+    def one(inflight, x):
+        b, i = x
+        nxt = exchange(b, i)        # bucket i's collective chain ...
+        out = finish(inflight)      # ... overlaps bucket i−1's unpack
+        return nxt, out
+
+    last, head = lax.scan(one, inflight, (buckets[1:], idx[1:]))
+    tail = finish(last)
+    return jnp.concatenate([head, tail[None]], axis=0), aux
+
+
 class CommSync:
     """One sync point's compiled-in schedule: built once per trainer
     from the spec, the mesh and an example pytree (shapes/dtypes), then
@@ -370,10 +537,17 @@ class CommSync:
 
     # ------------------------------------------------------- schedule
 
-    def reduce(self, tree, res=None, t=0):
+    def reduce(self, tree, res=None, t=0, compute=None):
         """Allreduce-SUM ``tree`` across the axis under the schedule.
         Returns ``(tree_summed, res_new)``; ``res_new`` is ``None``
-        exactly when :attr:`stateful` is false."""
+        exactly when :attr:`stateful` is false.
+
+        ``compute`` (optional zero-arg thunk of caller math that is
+        INDEPENDENT of the sync — e.g. the regularization gradient) is
+        evaluated next to the first in-flight bucket of the overlap
+        pipeline so the scheduler can hide the exchange behind it; its
+        result is returned as a third element:
+        ``(tree_summed, res_new, aux)``."""
         import jax
 
         if self.spec.schedule == "dense" or self.n_shards == 1:
@@ -381,14 +555,17 @@ class CommSync:
 
             out = jax.tree.map(
                 lambda x: lax.psum(x, self.axis_name), tree)
-            return out, res
-        return self._reduce_split(tree, res, t)
+            if compute is None:
+                return out, res
+            return out, res, compute()
+        return self._reduce_split(tree, res, t, compute)
 
-    def reduce_mean(self, tree, res=None, t=0):
+    def reduce_mean(self, tree, res=None, t=0, compute=None):
         """Allreduce-MEAN: ``dense`` uses ``lax.pmean`` (bitwise-equal
         to ``tree_allreduce_mean``); compressed schedules sum then
         divide. Error feedback is applied to the MEAN's deviation, so
-        the topk residual correction carries the right scale."""
+        the topk residual correction carries the right scale.
+        ``compute`` as in :meth:`reduce`."""
         import jax
 
         if self.spec.schedule == "dense" or self.n_shards == 1:
@@ -396,15 +573,19 @@ class CommSync:
 
             out = jax.tree.map(
                 lambda x: lax.pmean(x, self.axis_name), tree)
-            return out, res
+            if compute is None:
+                return out, res
+            return out, res, compute()
         if self.spec.schedule == "topk":
             # compress x/n so the residual tracks the mean-scale error
             scaled = jax.tree.map(lambda x: x / self.n_shards, tree)
-            return self._reduce_split(scaled, res, t)
-        out, res = self._reduce_split(tree, res, t)
-        return jax.tree.map(lambda x: x / self.n_shards, out), res
+            return self._reduce_split(scaled, res, t, compute)
+        ret = self._reduce_split(tree, res, t, compute)
+        out, res = ret[0], ret[1]
+        out = jax.tree.map(lambda x: x / self.n_shards, out)
+        return (out, res) if compute is None else (out, res, ret[2])
 
-    def _reduce_split(self, tree, res, t):
+    def _reduce_split(self, tree, res, t, compute=None):
         """Dense-psum the ineligible leaves, run the schedule on the
         eligible ones."""
         import jax
@@ -416,13 +597,16 @@ class CommSync:
             raise ValueError(
                 f"CommSync built for {len(self._eligible_mask)} leaves,"
                 f" got {len(leaves)}")
-        comp_out, res_new = self._run_schedule(comp, res, t)
+        comp_out, res_new, aux = self._run_schedule(comp, res, t,
+                                                    compute)
         it = iter(comp_out)
         out = [next(it) if e else lax.psum(x, self.axis_name)
                for x, e in zip(leaves, self._eligible_mask)]
-        return jax.tree.unflatten(treedef, out), res_new
+        out = jax.tree.unflatten(treedef, out)
+        return (out, res_new) if compute is None \
+            else (out, res_new, aux)
 
-    def _run_schedule(self, comp, res, t):
+    def _run_schedule(self, comp, res, t, compute=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -444,28 +628,13 @@ class CommSync:
                 off += sz
             return out
 
+        aux = None
+
         if sched == "bf16":
+            aux = compute() if compute is not None else None
             out = [lax.psum(x.astype(jnp.bfloat16), self.axis_name)
                    .astype(x.dtype) for x in comp]
-            return out, res
-
-        if sched == "int8":
-            key = jax.random.fold_in(
-                jax.random.fold_in(
-                    jax.random.key(self.spec.seed), t),
-                lax.axis_index(self.axis_name))
-            out = []
-            for i, x in enumerate(comp):
-                scale = lax.pmax(jnp.max(jnp.abs(x)),
-                                 self.axis_name) / 127.0
-                scale = jnp.maximum(scale, jnp.float32(1e-30))
-                u = jax.random.uniform(
-                    jax.random.fold_in(key, i), x.shape)
-                q = jnp.clip(jnp.floor(x / scale + u), -127, 127)
-                s = lax.psum(q.astype(jnp.int32), self.axis_name)
-                out.append((s.astype(jnp.float32) * scale)
-                           .astype(x.dtype))
-            return out, res
+            return out, res, aux
 
         if sched == "topk":
             n = self.n_shards
@@ -474,43 +643,15 @@ class CommSync:
                                  * max(1, self.ef_elems))))
             _, idx = lax.top_k(jnp.abs(flat), k)
             vals = flat[idx]
-            # the sparse allreduce is a RING ALL-GATHER of the k
-            # (value, index) pairs — n−1 ppermute hops of an 8k-byte
-            # buffer, so the bytes crossing the interconnect really
-            # are what stats() records (a psum of a zero-padded dense
-            # vector would move full-length f32 on the wire). Every
-            # shard then accumulates the n contributions in ORIGIN
-            # order (shard 0 first), so the float result is identical
-            # on every shard — the replicated-output contract psum
-            # gave us, kept without psum.
-            my = lax.axis_index(self.axis_name)
-            all_v = lax.dynamic_update_index_in_dim(
-                jnp.zeros((n, k), vals.dtype), vals, my, 0)
-            all_i = lax.dynamic_update_index_in_dim(
-                jnp.zeros((n, k), idx.dtype), idx, my, 0)
-            perm = _ring_perm(n)
-
-            def hop(s, carry):
-                v_buf, i_buf, all_v, all_i = carry
-                v_buf = lax.ppermute(v_buf, self.axis_name, perm)
-                i_buf = lax.ppermute(i_buf, self.axis_name, perm)
-                src = (my - s - 1) % n
-                all_v = lax.dynamic_update_index_in_dim(
-                    all_v, v_buf, src, 0)
-                all_i = lax.dynamic_update_index_in_dim(
-                    all_i, i_buf, src, 0)
-                return v_buf, i_buf, all_v, all_i
-
-            _, _, all_v, all_i = lax.fori_loop(
-                0, n - 1, hop, (vals, idx, all_v, all_i))
-            out = lax.fori_loop(
-                0, n,
-                lambda j, out: out.at[all_i[j]].add(all_v[j]),
-                jnp.zeros_like(flat))
+            # independent caller math next to the pair exchange — the
+            # sparse all-gather is the schedule's one in-flight bucket
+            aux = compute() if compute is not None else None
+            out = sparse_allreduce(vals, idx, flat.shape[0],
+                                   axis_name=self.axis_name, n=n)
             contrib = jnp.zeros_like(flat).at[idx].set(vals)
-            return unflatten(out), (flat - contrib)[None, :]
+            return unflatten(out), (flat - contrib)[None, :], aux
 
-        if sched in ("bucketed", "hier"):
+        if sched in ("bucketed", "hier", "int8"):
             n = self.n_shards
             g = self.groups if sched == "hier" else 1
             m = max(1, n // g)
@@ -522,7 +663,7 @@ class CommSync:
                 else n
             flat = flatten(comp)
             e = flat.shape[0]
-            if sched == "bucketed":
+            if sched in ("bucketed", "int8"):
                 n_buckets = max(1, math.ceil(e / self.spec.bucket_elems))
             else:
                 n_buckets = 1
@@ -530,19 +671,82 @@ class CommSync:
                 max(1, e) / (n_buckets * n_blocks))
             pad = n_buckets * bucket - e
             flat = jnp.pad(flat, (0, pad))
-            ring = (_ring_allreduce if sched == "bucketed"
-                    else lambda v, a, nn: _hier_allreduce(v, a, nn, g))
+            buckets = flat.reshape(n_buckets, bucket)
 
-            def one_bucket(_, b):
-                return None, ring(b, self.axis_name, n)
+            if sched == "int8":
+                exchange, finish = self._int8_bucket_ring(bucket, t)
+            else:
+                ring = (_ring_allreduce if sched == "bucketed"
+                        else lambda v, a, nn: _hier_allreduce(
+                            v, a, nn, g))
 
-            # scan pipelines bucket b's ppermute chain against bucket
-            # b−1's unpack — the overlapped-bucket schedule
-            _, out = lax.scan(
-                one_bucket, None, flat.reshape(n_buckets, bucket))
-            return unflatten(out.reshape(-1)[:e]), res
+                def exchange(b, i):
+                    del i
+                    return ring(b, self.axis_name, n)
+
+                def finish(b):
+                    return b
+
+            # double-buffered bucket pipeline: bucket b's collective
+            # chain overlaps bucket b−1's unpack/dequantize (and the
+            # caller's `compute` thunk rides next to the first bucket)
+            out, aux = _pipelined_buckets(
+                buckets, exchange, finish, self.spec.overlap, compute)
+            return unflatten(out.reshape(-1)[:e]), res, aux
 
         raise AssertionError(f"unreachable schedule {sched!r}")
+
+    def _int8_bucket_ring(self, bucket: int, t):
+        """The native int8 ring's per-bucket (exchange, finish) pair.
+
+        ``exchange``: quantize the f32 bucket against a pmax-shared
+        scale (seeded stochastic rounding), ``all_to_all`` the int8
+        chunks so chunk *c* of every shard lands on shard *c* (int8 on
+        the wire), accumulate the n integer contributions EXACTLY in
+        int32 (order-free ⇒ bitwise-deterministic and replicated by
+        construction), requantize the reduced chunk with a second
+        seeded stochastic rounding (scale ``n·s`` bounds the integer
+        sum, |Σq| ≤ 127n), then ring all-gather the int8 result chunk
+        with origin placement. ``finish``: dequantize — the only f32
+        work, pipelined against the NEXT bucket's exchange. The int32
+        widening happens strictly AFTER the collectives (TDA051 polices
+        the opposite order — the int32-psum wire this replaced)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        n = self.n_shards
+        chunk = bucket // n
+        axis = self.axis_name
+        my = lax.axis_index(axis)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(self.spec.seed), t), my)
+
+        def exchange(b, i):
+            scale = lax.pmax(jnp.max(jnp.abs(b)), axis) / 127.0
+            scale = jnp.maximum(scale, jnp.float32(1e-30))
+            u = jax.random.uniform(
+                jax.random.fold_in(key, 2 * i), b.shape)
+            q = jnp.clip(jnp.floor(b / scale + u),
+                         -127, 127).astype(jnp.int8)
+            # chunk c of every shard → shard c, as int8
+            recv = lax.all_to_all(
+                q.reshape(n, chunk), axis,
+                split_axis=0, concat_axis=0, tiled=True)
+            s_int = jnp.sum(recv.astype(jnp.int32), axis=0)  # exact
+            u2 = jax.random.uniform(
+                jax.random.fold_in(key, 2 * i + 1), s_int.shape)
+            q2 = jnp.clip(jnp.floor(s_int.astype(jnp.float32) / n + u2),
+                          -127, 127).astype(jnp.int8)
+            return _ring_allgather(q2, axis, n), scale
+
+        def finish(carry):
+            all_q2, scale = carry
+            # chunk c sits in row c: row-major reshape restores order
+            return (all_q2.astype(jnp.float32)
+                    * (scale * n)).reshape(-1)
+
+        return exchange, finish
 
     # ---------------------------------------------------------- stats
 
@@ -553,14 +757,14 @@ class CommSync:
         collective ``rounds`` launched per sync.
 
         This is the SCHEDULE'S payload accounting — what each sync
-        fundamentally has to move — not a measurement of the XLA
-        lowering underneath. bf16 and topk match it on the wire today
-        (a bf16 psum moves bf16; topk's ring all-gather moves exactly
-        the 8k-byte pair buffers). int8 is the known gap: XLA has no
-        int8 AllReduce, so the quantized payload rides an int32 psum
-        (4 bytes/elem on the wire until a custom collective lands) —
-        the counter records the schedule's achievable bytes, which is
-        what the --comm knob is selecting for."""
+        fundamentally has to move — and since round 11 every schedule
+        matches it on the wire: a bf16 psum moves bf16, topk's ring
+        all-gather moves exactly the 8k-byte pair buffers, and int8
+        runs the NATIVE compressed ring (``all_to_all`` chunk scatter +
+        int8 ring all-gather, 1 byte/elem in both phases — the int32
+        widening happens locally after the exchange, never on the
+        wire; PR 5's int32-psum caveat is closed, and lint rule TDA051
+        keeps it closed)."""
         n = self.n_shards
         dense_elems = sum(
             s for s, e in zip(self._sizes, self._eligible_mask)
@@ -569,7 +773,6 @@ class CommSync:
         ring = 2.0 * (n - 1) / n if n > 1 else 0.0
         b_logical = 4 * (ce + dense_elems)
         dense_wire = 4 * dense_elems * ring
-        n_comp_leaves = sum(self._eligible_mask)
         sched = self.spec.schedule
         if sched == "dense" or n == 1:
             wire = 4 * ce * ring + dense_wire
@@ -578,9 +781,13 @@ class CommSync:
             wire = 2 * ce * ring + dense_wire
             rounds = 1 + (1 if dense_elems else 0)
         elif sched == "int8":
-            # int8 payload + one f32 pmax per leaf for the shared scale
-            wire = ce * ring + 4 * n_comp_leaves * ring + dense_wire
-            rounds = 2 * n_comp_leaves + (1 if dense_elems else 0)
+            # native ring: int8 both phases (scatter (n−1)/n + gather
+            # (n−1)/n = the ring constant at 1 byte/elem), one f32
+            # pmax per BUCKET for the shared scale (the requant scale
+            # n·s is derived, no extra collective)
+            nb = max(1, math.ceil(max(1, ce) / self.spec.bucket_elems))
+            wire = ce * ring + 4 * nb * ring + dense_wire
+            rounds = 3 * nb + (1 if dense_elems else 0)
         elif sched == "topk":
             k = max(1, int(round(self.spec.topk_fraction * max(1, ce))))
             # k (value, index) pairs exchanged all-gather-style
@@ -625,3 +832,18 @@ def emit_sync_counters(sync: CommSync, n_syncs: int) -> dict:
     tevents.counter("comm.rounds", st["rounds"] * n_syncs)
     tevents.counter("comm.syncs", n_syncs)
     return st
+
+
+def emit_overlap_counters(hidden_ms: float, comm_ms: float) -> None:
+    """Bump the overlap-efficiency counters ``tda report`` renders:
+    ``comm.overlap_hidden_ms`` is comm time HIDDEN behind compute
+    (measured as the sequential-vs-overlapped step-time delta × sync
+    count — the honest host-side observable), ``comm.sync_ms`` the comm
+    time still exposed (schedule-vs-dense delta under overlap). The
+    report line shows hidden / (hidden + exposed) as the fraction of
+    comm time the pipeline hid. No-op when telemetry is disabled."""
+    from tpu_distalg.telemetry import events as tevents
+
+    tevents.counter("comm.overlap_hidden_ms",
+                    max(0, int(round(hidden_ms))))
+    tevents.counter("comm.sync_ms", max(0, int(round(comm_ms))))

@@ -235,6 +235,21 @@ def render(s: dict) -> str:
                 f"{s['counters'].get('comm.syncs', 0)} sync(s), "
                 f"{s['counters'].get('comm.rounds', 0)} collective "
                 f"round(s)")
+        hid = s["counters"].get("comm.overlap_hidden_ms")
+        exposed = s["counters"].get("comm.sync_ms")
+        if hid is not None or exposed is not None:
+            # overlap efficiency (parallel/comms.py bucket pipeline):
+            # hidden = comm time the double-buffered schedule removed
+            # vs its sequential A/B (measured host-side), exposed =
+            # comm time still visible over the dense-compute baseline;
+            # the fraction is how much of the schedule's comm the
+            # pipeline hid behind compute
+            hid = hid or 0
+            total = hid + (exposed or 0)
+            frac = (hid / total) if total else 0.0
+            lines.append(
+                f"comm overlap: {hid} ms hidden behind compute "
+                f"({frac:.0%} of {total} ms comm time)")
     if s["gauges"]:
         lines.append("gauges: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["gauges"].items())))
