@@ -123,6 +123,36 @@ int32_t tda_counting_sort_perm(const int64_t* keys, int64_t n,
   return 0;
 }
 
+// Interleave dst-sorted edge columns into the packed csr_edge_blocks_i32
+// cache rows: out[3i..3i+2] = [src, dst, bits(w)] as int32 (the f32 weight
+// travels as its bit pattern so the whole row matrix is one dtype — the
+// packed-cache format holds exactly one). Vertex ids must fit int32 (the
+// cache layout's id width); callers validate the range. Multi-threaded:
+// the row interleave is the last O(E) host pass of a 10M+ edge ingest.
+void tda_pack_edge_rows(const int64_t* src, const int64_t* dst,
+                        const float* w, int64_t n, int32_t* out) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int n_threads = (n >= (1 << 20) && hw > 1) ? static_cast<int>(hw) : 1;
+  auto pack = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      out[3 * i] = static_cast<int32_t>(src[i]);
+      out[3 * i + 1] = static_cast<int32_t>(dst[i]);
+      std::memcpy(&out[3 * i + 2], &w[i], sizeof(int32_t));
+    }
+  };
+  if (n_threads <= 1) {
+    pack(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo < hi) threads.emplace_back(pack, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
 // Parse a whitespace-delimited "src dst" text edge list (comments: lines
 // starting with '#'). Returns edges read, or -1 on open failure, or -2 if
 // the caller's capacity was too small.

@@ -21,6 +21,12 @@ is the fault schedule, not the FLOPs:
                      chaos (``data:gather`` / ``data:h2d`` faults;
                      stateless, so a restart re-runs from step 0
                      deterministically)
+  ``pagerank_stream``  streamed PageRank over a power-law edge-block
+                     cache (``tpu_distalg/graphs/``) — the out-of-core
+                     frontier sweep under chaos: the block gather/H2D
+                     path runs through the same ``data:gather`` /
+                     ``data:h2d`` seams, checkpointed so a mid-sweep
+                     fault resumes the power iteration bitwise
 
 Used three ways: the ``tda chaos`` CLI subcommand (rc 1 on any
 mismatch), ``tests/test_faults.py``'s acceptance grid, and ad-hoc
@@ -37,7 +43,8 @@ import numpy as np
 from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
 
-WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream")
+WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream",
+             "pagerank_stream")
 
 # enough restarts to survive a multi-fault schedule without masking a
 # deterministic bug forever (a fault that keeps re-firing on @* rules
@@ -75,15 +82,19 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
     if workload == "als":
         return {"U": np.asarray(res.U), "V": np.asarray(res.V),
                 "rmse_history": np.asarray(res.rmse_history)}
+    if workload == "pagerank_stream":
+        return {"ranks": np.asarray(res.ranks)}
     raise ValueError(f"unknown chaos workload {workload!r}; choose from "
                      f"{WORKLOADS}")
 
 
 def _make_runner(workload: str, mesh, n_iterations: int | None,
-                 checkpoint_every: int | None):
+                 checkpoint_every: int | None, workdir: str):
     """Build ``run(checkpoint_dir) -> result`` for one workload, small
     defaults. ``checkpoint_dir=None`` runs unsegmented (kmeans_stream —
-    stateless, restart-from-scratch recovery)."""
+    stateless, restart-from-scratch recovery). ``workdir`` hosts any
+    on-disk artifact the workload needs beyond checkpoints (the
+    streamed graph cache)."""
     if workload == "lr":
         from tpu_distalg.models import logistic_regression as m
         from tpu_distalg.utils import datasets
@@ -145,6 +156,31 @@ def _make_runner(workload: str, mesh, n_iterations: int | None,
             return m.fit_minibatch(ds, cfg, n_steps=steps,
                                    mini_batch_blocks=2)
         return run
+    if workload == "pagerank_stream":
+        import os
+
+        from tpu_distalg import graphs
+        from tpu_distalg.parallel import DATA_AXIS
+
+        n_shards = int(mesh.shape[DATA_AXIS])
+        # the cache is built ONCE, outside both runs (its publish path
+        # has its own cache:write seam coverage in test_faults) — the
+        # chaos surface here is the streamed sweep's gather/H2D path
+        path = os.path.join(workdir, "graph", "powerlaw")
+        graphs.build_powerlaw_block_cache(
+            path, n_vertices=2048, n_shards=n_shards,
+            avg_in_degree=8.0, alpha=1.6, seed=1, block_edges=512)
+        cfg = graphs.StreamedPageRankConfig(
+            n_iterations=n_iterations or 6)
+        every = checkpoint_every or 2
+
+        def run(ckpt_dir):
+            gd = graphs.open_graph_dataset(path, mesh,
+                                           backend="streamed")
+            return graphs.run_streamed_pagerank(
+                gd, cfg, checkpoint_dir=ckpt_dir,
+                checkpoint_every=every)
+        return run
     raise ValueError(f"unknown chaos workload {workload!r}; choose from "
                      f"{WORKLOADS}")
 
@@ -168,7 +204,8 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     if isinstance(plan, str):
         plan = faults.FaultPlan.parse(plan)
     log = logger or (lambda m: None)
-    runner = _make_runner(workload, mesh, n_iterations, checkpoint_every)
+    runner = _make_runner(workload, mesh, n_iterations, checkpoint_every,
+                          workdir)
     uses_ckpt = workload != "kmeans_stream"
 
     def dirpath(name):

@@ -1,0 +1,357 @@
+"""Edge-list ingest into destination-sorted CSR edge-block caches.
+
+This is the storage half of the out-of-core graph engine: edges land in
+the versioned packed-cache disk format (``data/cache.py``) as packed
+``(n_rows, 3)`` int32 rows ``[src, dst, bits(w)]`` in the
+``csr_edge_blocks_i32`` layout — globally **destination-sorted**, tail-
+padded with inert edges (zero weight, replicated last real dst), and
+contiguously sharded so shard *s* owns rows ``[s·L, (s+1)·L)``. The
+two properties every consumer leans on:
+
+  * **dst-sortedness survives slicing** — any contiguous row range is a
+    dst-sorted edge block, so the streamed sweep's per-block scatter is
+    a ``segment_sum(indices_are_sorted=True)`` exactly like the
+    resident path's (``models/pagerank.py``);
+  * **each shard covers a contiguous destination window** ``[lo_s,
+    hi_s]`` — its partial rank contributions live in an O(window)
+    accumulator instead of O(V), and the cross-shard combine touches
+    only the destinations the shard actually has edges into, which is
+    what makes ``comms.sparse_allreduce`` the right combine on
+    power-law graphs (arXiv:1312.3020).
+
+The header's ``geom`` records the whole sweep geometry (vertex/edge
+counts, block size, shard windows, the sparse-combine width ``k``);
+three aux payloads carry the O(V)/O(D) side arrays the engine needs on
+device (out-degrees, per-shard distinct-destination ids + validity
+mask). Content is deterministic in the header whichever ingest path
+produced it — native C++ (``native.pack_edge_rows`` + the counting
+sorts) and the pure-NumPy fallback are byte-identical, so the
+capability skip for a stale/absent ``libtda_ingest.so`` degrades speed,
+never bytes (pinned in tests/test_graphs.py).
+
+Two builders:
+
+  :func:`build_edge_block_cache`
+      the general path — any in-host-RAM edge array (dedupe + degree +
+      dst counting sort, all native-accelerated);
+  :func:`build_powerlaw_block_cache`
+      the >RAM path for synthetic benchmark graphs: a deterministic
+      power-law in-degree profile generated **already dst-sorted** in
+      O(chunk) host memory (two passes: out-degree histogram, then
+      write), so a 100M-vertex / billion-edge cache never needs the
+      edge list materialized — the ingest analogue of what
+      ``data/builders.py`` does for SGD datasets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from tpu_distalg.data import cache as dcache
+from tpu_distalg.telemetry import events as tevents
+
+LAYOUT = "csr_edge_blocks_i32"
+#: bumped when the row packing / geom contract changes; carried in geom
+#: so an old cache reopens against the matching reader or fails loudly
+BLOCK_FORMAT_VERSION = 1
+ROW_WIDTH = 3  # [src, dst, bits(w)] int32
+DEFAULT_BLOCK_EDGES = 1 << 16
+#: aux payload names (``<path>.<name>`` files beside the .bin)
+AUX_DEG = "deg"      # (V,) int32 out-degrees
+AUX_DIDX = "didx"    # (n_shards, k) int32 LOCAL window offsets
+AUX_DMASK = "dmask"  # (n_shards, k) f32 validity (0 = padding pair)
+#: powerlaw builder generation chunk (EDGE rows per RNG chunk — a
+#: power-law profile concentrates nearly all edges on the first few
+#: hub vertices, so chunking by vertex would put ~the whole edge list
+#: in chunk 0 and blow the O(chunk) host-RAM bound). The bytes are a
+#: pure function of (seed, chunk index), so the chunk size is part of
+#: the geometry and changing it regenerates the cache
+POWERLAW_CHUNK_EDGES = 1 << 24
+
+
+def _geom_arrays(counts_real: np.ndarray, n_vertices: int,
+                 n_shards: int, block_edges: int):
+    """Sweep geometry from the per-destination edge counts alone —
+    shared by both builders so the general and synthetic paths can
+    never disagree about windows.
+
+    Returns ``(geom, ids, mask, n_rows, pad_dst)``: the JSON geometry
+    dict, the per-shard distinct-destination LOCAL offsets ``(S, k)``
+    with their validity mask, the padded row count, and the (inert)
+    destination padding rows replicate.
+    """
+    V = int(n_vertices)
+    counts_real = np.asarray(counts_real, np.int64)
+    E = int(counts_real.sum())
+    if E == 0:
+        raise ValueError("cannot build an edge-block cache from an "
+                         "empty edge list")
+    if V > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"{V} vertices exceed the int32 id width of the "
+            f"{LAYOUT} layout")
+    gran = n_shards * block_edges
+    n_rows = -(-E // gran) * gran
+    n_pad = n_rows - E
+    # padding replicates the LAST REAL dst (order-preserving, zero
+    # weight) so the final shard's window stays tight — padding at
+    # dst=V-1 would stretch it to the whole tail of absent vertices
+    pad_dst = int(np.flatnonzero(counts_real)[-1])
+    counts_pad = counts_real.copy()
+    counts_pad[pad_dst] += n_pad
+    cum = np.zeros(V + 1, np.int64)
+    np.cumsum(counts_pad, out=cum[1:])
+    L = n_rows // n_shards
+    starts = np.arange(n_shards, dtype=np.int64) * L
+    lo = (np.searchsorted(cum, starts, side="right") - 1).astype(np.int64)
+    hi = (np.searchsorted(cum, starts + L - 1, side="right") - 1
+          ).astype(np.int64)
+    window = int((hi - lo + 1).max())
+    window = -(-window // 8) * 8  # sublane-aligned accumulator rows
+    # per-shard distinct REAL destinations, as LOCAL window offsets —
+    # the static index set the sparse combine gathers; a dst whose rows
+    # straddle a shard boundary appears in BOTH shards (its two partial
+    # sums meet in the combine)
+    locals_, k = [], 1
+    for s in range(n_shards):
+        d = np.flatnonzero(counts_real[lo[s]:hi[s] + 1]).astype(np.int32)
+        locals_.append(d)
+        k = max(k, len(d))
+    ids = np.zeros((n_shards, k), np.int32)
+    mask = np.zeros((n_shards, k), np.float32)
+    for s, d in enumerate(locals_):
+        ids[s, :len(d)] = d
+        mask[s, :len(d)] = 1.0
+    geom = {
+        "bv": BLOCK_FORMAT_VERSION,
+        "n_vertices": V,
+        "n_edges": E,
+        "block_edges": int(block_edges),
+        "n_shards": int(n_shards),
+        "window": window,
+        "k_sparse": int(k),
+        "lo": [int(x) for x in lo],
+    }
+    return geom, ids, mask, int(n_rows), pad_dst
+
+
+def _aux_writers(deg: np.ndarray, ids: np.ndarray, mask: np.ndarray):
+    deg_i32 = np.ascontiguousarray(deg, np.int32)
+    return [
+        (AUX_DEG, lambda tmp: deg_i32.tofile(tmp)),
+        (AUX_DIDX, lambda tmp: np.ascontiguousarray(ids).tofile(tmp)),
+        (AUX_DMASK, lambda tmp: np.ascontiguousarray(mask).tofile(tmp)),
+    ]
+
+
+def inv_out_degree(deg: np.ndarray) -> np.ndarray:
+    """Per-vertex ``1/out_degree`` (0 for sinks) — THE per-edge weight
+    definition, shared with every resident sweep path
+    (``models/pagerank._inv_out_degree`` delegates here) so ingest and
+    resident prep cannot diverge."""
+    deg = np.asarray(deg).astype(np.float32)
+    return np.where(deg > 0, 1.0 / np.maximum(deg, 1.0),
+                    0.0).astype(np.float32)
+
+
+def build_edge_block_cache(edges: np.ndarray, path: str, *,
+                           n_shards: int,
+                           block_edges: int = DEFAULT_BLOCK_EDGES,
+                           n_vertices: int | None = None,
+                           source: dict | None = None):
+    """Ingest an in-RAM ``(E, 2)`` edge array into a complete (or
+    reopened) edge-block cache at ``path``; returns ``(memmap, header)``.
+
+    The full native pipeline of ``models/pagerank.prepare_device_edges``
+    runs host-side ONCE at ingest instead of at every load: dedupe
+    (``links.distinct()`` semantics), out-degree histogram, O(E) dst
+    counting sort, per-edge ``1/out_degree[src]`` weight gather, packed
+    row interleave — each step C++-accelerated when ``libtda_ingest.so``
+    carries the symbol and NumPy otherwise, byte-identically.
+
+    ``source`` tags the geometry with the edges' provenance (generator
+    kind/seed, file name...); when omitted, a content hash of the edge
+    bytes stands in — either way a reopen against DIFFERENT edges at
+    the same path fails the geometry check instead of silently sweeping
+    the wrong graph.
+    """
+    from tpu_distalg import native
+    from tpu_distalg.ops import graph as gops
+
+    if source is None:
+        source = {"kind": "edges",
+                  "sha1": hashlib.sha1(
+                      np.ascontiguousarray(edges, np.int64).tobytes()
+                  ).hexdigest()}
+    if dcache.exists(path):
+        # reopen WITHOUT the O(E) dedupe/sort pipeline: equal source
+        # (a content hash unless the caller tagged its own provenance)
+        # + equal build parameters imply the identical derived
+        # geometry — ingest is deterministic per block-format version
+        mm, header = dcache.open_cache(path, layout=LAYOUT)
+        geom = header["geom"]
+        n_v = (int(n_vertices) if n_vertices is not None
+               else int(np.asarray(edges).max()) + 1)
+        expect = {"bv": BLOCK_FORMAT_VERSION, "n_vertices": n_v,
+                  "n_shards": int(n_shards),
+                  "block_edges": int(block_edges),
+                  "source": dict(source)}
+        got = {k: geom.get(k) for k in expect}
+        if got != expect:
+            raise ValueError(
+                f"edge-block cache at {path!r} was built with "
+                f"{got}, this call wants {expect}; delete the cache "
+                f"or use another path")
+        return mm, header
+    el = gops.prepare_edges(edges, n_vertices)
+    counts = np.bincount(el.dst, minlength=el.n_vertices)
+    geom, ids, mask, n_rows, pad_dst = _geom_arrays(
+        counts, el.n_vertices, n_shards, block_edges)
+    geom["source"] = dict(source)
+    header = dcache.make_header(layout=LAYOUT, dtype="int32",
+                                shape=[n_rows, ROW_WIDTH], geom=geom)
+
+    order = native.counting_sort_perm(el.dst, el.n_vertices)
+    src_o = el.src[order].astype(np.int64)
+    dst_o = el.dst[order].astype(np.int64)
+    w = inv_out_degree(el.out_degree)[src_o]
+    packed = native.pack_edge_rows(src_o, dst_o, w)
+    E = el.n_edges
+
+    def write_bin(mm):
+        mm[:E] = packed
+        mm[E:, 0] = 0
+        mm[E:, 1] = pad_dst
+        mm[E:, 2] = 0  # bits(0.0f) — inert weight
+
+    tevents.counter("graph.ingest_edges", E)
+    return dcache.build_cache(path, header=header, write_bin=write_bin,
+                              aux=_aux_writers(el.out_degree, ids, mask))
+
+
+def powerlaw_in_degree_counts(n_vertices: int, avg_in_degree: float,
+                              alpha: float) -> np.ndarray:
+    """The deterministic power-law in-degree profile the synthetic
+    builder writes: ``in_deg(d) = rint(A·(d+1)^-alpha)`` with ``A``
+    normalized so the total edge count lands near
+    ``n_vertices·avg_in_degree``. Low ids are the hubs; the tail has
+    in-degree zero — the distinct-destination set is a small fraction
+    of V, which is exactly the sparsity the rank combine exploits."""
+    d = np.arange(n_vertices, dtype=np.float64)
+    base = (d + 1.0) ** (-float(alpha))
+    A = n_vertices * float(avg_in_degree) / float(base.sum())
+    counts = np.rint(A * base).astype(np.int64)
+    counts[0] = max(int(counts[0]), 1)
+    return counts
+
+
+def build_powerlaw_block_cache(path: str, *, n_vertices: int,
+                               n_shards: int,
+                               avg_in_degree: float = 8.0,
+                               alpha: float = 1.6, seed: int = 0,
+                               block_edges: int = DEFAULT_BLOCK_EDGES,
+                               chunk_edges: int = POWERLAW_CHUNK_EDGES):
+    """Synthesize a power-law graph DIRECTLY into a dst-sorted block
+    cache in O(V + chunk) host memory; returns ``(memmap, header)``.
+
+    Destinations are generated in ascending order with the
+    deterministic :func:`powerlaw_in_degree_counts` profile, so the
+    global dst sort the general path pays (and could not pay out of
+    core) is free by construction. Generation chunks are EDGE-row
+    ranges (a hub vertex's edges span as many chunks as they need —
+    chunking by vertex would put essentially the whole edge list in
+    the first chunk on a power-law profile). Sources are uniform
+    draws keyed ``rng(seed, chunk)``, so pass 1 (the out-degree
+    histogram) and pass 2 (the write inside the cache build) see
+    identical edges — and so do two concurrent builders, which the
+    packed-cache publish protocol requires. Self-loops and duplicate
+    edges are allowed (multigraph semantics; the profile, not
+    set-dedupe, is the point of this generator — recorded in
+    ``geom['source']``)."""
+    V = int(n_vertices)
+    counts = powerlaw_in_degree_counts(V, avg_in_degree, alpha)
+    geom, ids, mask, n_rows, pad_dst = _geom_arrays(
+        counts, V, n_shards, block_edges)
+    geom["source"] = {"kind": "powerlaw", "n_vertices": V,
+                      "avg_in_degree": float(avg_in_degree),
+                      "alpha": float(alpha), "seed": int(seed),
+                      "chunk_edges": int(chunk_edges),
+                      "deduped": False}
+    header = dcache.make_header(layout=LAYOUT, dtype="int32",
+                                shape=[n_rows, ROW_WIDTH], geom=geom)
+    if dcache.exists(path):
+        return dcache.open_cache(path, layout=LAYOUT, expect_geom=geom)
+
+    from tpu_distalg import native
+
+    E = int(counts.sum())
+    cum = np.zeros(V + 1, np.int64)
+    np.cumsum(counts, out=cum[1:])
+    chunks = [(e0, min(E, e0 + chunk_edges))
+              for e0 in range(0, E, chunk_edges)]
+
+    def chunk_src(ci, n_c):
+        return np.random.default_rng((seed, ci)).integers(
+            0, V, size=n_c, dtype=np.int64)
+
+    def chunk_dst(e0, e1):
+        # destinations for edge rows [e0, e1): vertex v owns rows
+        # [cum[v], cum[v+1]), so the range spans vertices v0..v1 with
+        # the boundary vertices' counts trimmed to the overlap
+        v0 = int(np.searchsorted(cum, e0, side="right")) - 1
+        v1 = int(np.searchsorted(cum, e1 - 1, side="right")) - 1
+        c = counts[v0:v1 + 1].copy()
+        c[0] -= e0 - cum[v0]
+        c[-1] -= cum[v1 + 1] - e1
+        return np.repeat(np.arange(v0, v1 + 1, dtype=np.int64), c)
+
+    # pass 1: out-degree histogram (O(V) ints, O(chunk) edges in RAM)
+    deg = np.zeros(V, np.int64)
+    with tevents.span("graph:ingest_degree", n_vertices=V, n_edges=E):
+        for ci, (e0, e1) in enumerate(chunks):
+            deg += np.bincount(chunk_src(ci, e1 - e0), minlength=V)
+    inv = inv_out_degree(deg)
+
+    def write_bin(mm):
+        for ci, (e0, e1) in enumerate(chunks):
+            src = chunk_src(ci, e1 - e0)
+            mm[e0:e1] = native.pack_edge_rows(src, chunk_dst(e0, e1),
+                                              inv[src])
+            tevents.mark("data:cache_build", emit_event=False)
+        mm[E:, 0] = 0
+        mm[E:, 1] = pad_dst
+        mm[E:, 2] = 0
+
+    tevents.counter("graph.ingest_edges", E)
+    return dcache.build_cache(path, header=header, write_bin=write_bin,
+                              aux=_aux_writers(deg, ids, mask))
+
+
+def read_aux(path: str, geom: dict):
+    """Load the three aux payloads beside a complete block cache:
+    ``(deg, didx, dmask)`` with shapes validated against the geometry.
+    Raises ``FileNotFoundError`` naming the regenerate remedy when an
+    aux file is missing (a partial/legacy publish)."""
+    import os
+
+    V = int(geom["n_vertices"])
+    S, k = int(geom["n_shards"]), int(geom["k_sparse"])
+    out = []
+    for name, dtype, shape in ((AUX_DEG, np.int32, (V,)),
+                               (AUX_DIDX, np.int32, (S, k)),
+                               (AUX_DMASK, np.float32, (S, k))):
+        p = dcache.aux_path(path, name)
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"edge-block cache at {path!r} has no {name!r} aux "
+                f"payload — a partial or pre-{LAYOUT} publish; delete "
+                f"the cache and re-ingest")
+        arr = np.fromfile(p, dtype=dtype)
+        if arr.size != int(np.prod(shape)):
+            raise ValueError(
+                f"aux payload {p!r} holds {arr.size} elements, "
+                f"geometry wants {shape}")
+        out.append(arr.reshape(shape))
+    return tuple(out)

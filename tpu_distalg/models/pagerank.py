@@ -130,12 +130,49 @@ class DeviceEdges:
     spmv: DeviceSpMV | None = None  # fused Path E prep (scatter='spmv')
 
 
+def resident_guard_trips(n_vertices: int) -> bool:
+    """True when the fused-SpMV VMEM guard would reject this vertex
+    count even at the smallest scatter window — the documented ~12M
+    resident ceiling (``ops/pallas_pagerank.SPMV_VMEM_BUDGET``). The
+    signal the CLI keys its warn-and-degrade-to-streamed on: past this
+    line the resident paths either refuse (spmv) or fall back to
+    sweeps that need the whole edge set HBM-resident anyway."""
+    from tpu_distalg.ops import pallas_pagerank as ppr
+
+    return ppr.spmv_resident_bytes(n_vertices, ppr.SPMV_RG, 8) \
+        > ppr.SPMV_VMEM_BUDGET
+
+
+def choose_data_backend(requested: str, n_vertices: int,
+                        scatter: str = "auto"
+                        ) -> tuple[str, str | None]:
+    """Resolve the pagerank ``--data-backend`` knob against the
+    resident VMEM guard: a resident request past the ceiling degrades
+    to streamed WITH a warning instead of dying minutes later in the
+    sweep prep (the guard used to just refuse). An EXPLICIT
+    ``--scatter xla``/``pallas`` resident request is honored — the
+    ceiling is the fused-SpMV kernel's table budget, and those sweeps
+    carry their own (HBM/plan) limits with remedy-naming errors.
+    Returns ``(backend, warning-or-None)``."""
+    if requested == "resident" and scatter in ("auto", "spmv") \
+            and resident_guard_trips(n_vertices):
+        return "streamed", (
+            f"[pagerank] {n_vertices} vertices exceed the resident "
+            f"sweep's VMEM guard (~12M ceiling, "
+            f"ops/pallas_pagerank.SPMV_VMEM_BUDGET) — degrading to "
+            f"--data-backend streamed (tpu_distalg/graphs/: edge "
+            f"blocks stream from disk, only O(V) state stays in HBM)")
+    return requested, None
+
+
 def _inv_out_degree(el: gops.EdgeList) -> np.ndarray:
     """Per-vertex 1/out_degree (0 for sinks) — THE per-edge weight
-    definition, shared by every sweep path so they cannot diverge."""
-    deg = el.out_degree.astype(np.float32)
-    return np.where(deg > 0, 1.0 / np.maximum(deg, 1.0),
-                    0.0).astype(np.float32)
+    definition, shared by every sweep path (the graph engine's ingest
+    included: ``graphs/ingest.inv_out_degree`` is the one
+    implementation) so they cannot diverge."""
+    from tpu_distalg.graphs.ingest import inv_out_degree
+
+    return inv_out_degree(el.out_degree)
 
 
 def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
@@ -320,14 +357,23 @@ def make_run_fn(mesh: Mesh, config: PageRankConfig, n_vertices: int,
         raise ValueError(
             "scatter='pallas' needs a scatter plan — the graph's dst "
             "distribution was too sparse/skewed for a bounded window "
-            "(ops/pallas_pagerank.plan_scatter returned None)"
+            "(ops/pallas_pagerank.plan_scatter returned None). For "
+            "graphs past the resident ceiling, use the streamed "
+            "engine instead: --data-backend streamed "
+            "(tpu_distalg/graphs/)"
         )
     if config.mode == "standard" and config.scatter == "spmv" \
             and spmv is None:
         raise ValueError(
             "scatter='spmv' needs the fused-SpMV plan — build the "
             "DeviceSpMV via prepare_device_spmv (None means the "
-            "graph's windows exceeded ops/pallas_pagerank caps)"
+            "graph's windows exceeded ops/pallas_pagerank caps, or "
+            "the kernel-resident VMEM footprint blew "
+            "SPMV_VMEM_BUDGET — the ~12M-vertex ceiling). Graphs "
+            "past the resident ceiling belong on the out-of-core "
+            "engine: --data-backend streamed (tpu_distalg/graphs/ "
+            "streams edge blocks from disk; only O(V) state stays "
+            "in HBM)"
         )
 
     if config.mode == "reference":

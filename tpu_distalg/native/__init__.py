@@ -18,6 +18,13 @@ _LIB_NAME = "libtda_ingest.so"
 _here = os.path.dirname(__file__)
 _lib = None
 _load_attempted = False
+#: symbols added after the first shipped .so — a prebuilt library may
+#: predate them. load() tries ONE rebuild when any is missing; entry
+#: points whose symbol still is not there fall back to NumPy (a stale
+#: binary must degrade per-capability, never crash the import or the
+#: caller).
+_OPTIONAL_SYMBOLS = ("tda_pack_edge_rows",)
+_missing_symbols: frozenset = frozenset()
 
 
 def _build() -> bool:
@@ -35,22 +42,47 @@ def _build() -> bool:
         return False
 
 
+def _open_lib(path: str) -> ctypes.CDLL | None:
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None
+
+
 def load() -> ctypes.CDLL | None:
     """The loaded library, building it on first use if needed; None when
-    unavailable (callers fall back to NumPy)."""
-    global _lib, _load_attempted
+    unavailable (callers fall back to NumPy).
+
+    Capability handling for stale binaries: a prebuilt ``.so`` that
+    predates :data:`_OPTIONAL_SYMBOLS` triggers ONE rebuild attempt
+    (same build-if-missing path); if the rebuild cannot run (no
+    compiler, read-only checkout) the library still loads with the
+    missing entry points recorded in :data:`_missing_symbols` — their
+    Python wrappers fall back to NumPy instead of raising
+    ``AttributeError`` mid-ingest."""
+    global _lib, _load_attempted, _missing_symbols
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
     path = os.path.join(_here, _LIB_NAME)
     if not os.path.exists(path) and not _build():
         return None
-    try:
-        lib = ctypes.CDLL(path)
-    except OSError:
+    lib = _open_lib(path)
+    if lib is None:
         return None
+    stale = [s for s in _OPTIONAL_SYMBOLS if not hasattr(lib, s)]
+    if stale and _build():
+        # a fresh build carries every symbol this binding knows about;
+        # reopen so the new ones resolve (dlopen caches per path, but
+        # the handle we already hold keeps the OLD mapping alive)
+        rebuilt = _open_lib(path)
+        if rebuilt is not None:
+            lib = rebuilt
+            stale = [s for s in _OPTIONAL_SYMBOLS if not hasattr(lib, s)]
+    _missing_symbols = frozenset(stale)
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     lib.tda_dedupe_edges.argtypes = [i64p, i64p, ctypes.c_int64]
     lib.tda_dedupe_edges.restype = ctypes.c_int64
     lib.tda_out_degree.argtypes = [i64p, ctypes.c_int64, i32p,
@@ -65,12 +97,45 @@ def load() -> ctypes.CDLL | None:
     lib.tda_counting_sort_perm.argtypes = [i64p, ctypes.c_int64,
                                            ctypes.c_int64, i64p]
     lib.tda_counting_sort_perm.restype = ctypes.c_int32
+    if "tda_pack_edge_rows" not in _missing_symbols:
+        lib.tda_pack_edge_rows.argtypes = [i64p, i64p, f32p,
+                                           ctypes.c_int64, i32p]
+        lib.tda_pack_edge_rows.restype = None
     _lib = lib
     return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+def has_symbol(name: str) -> bool:
+    """Whether the loaded library exports ``name`` — False when the
+    library is absent OR it loaded as a stale build missing the symbol
+    (the per-capability skip the graph ingest keys its fallback on)."""
+    return load() is not None and name not in _missing_symbols
+
+
+def pack_edge_rows(src: np.ndarray, dst: np.ndarray,
+                   w: np.ndarray) -> np.ndarray:
+    """Interleave dst-sorted edge columns into packed ``(E, 3)`` int32
+    cache rows ``[src, dst, bits(w)]`` — the ``csr_edge_blocks_i32``
+    layout (``tpu_distalg/graphs/ingest.py``). Native path and NumPy
+    fallback are byte-identical (int32 truncation of in-range ids +
+    the f32 bit pattern), so a cache is deterministic in its header
+    whichever path built it."""
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n = len(src)
+    out = np.empty((n, 3), dtype=np.int32)
+    if n and has_symbol("tda_pack_edge_rows"):
+        load().tda_pack_edge_rows(src, dst, w, n, out)
+        return out
+    out[:, 0] = src.astype(np.int32)
+    out[:, 1] = dst.astype(np.int32)
+    out[:, 2] = w.view(np.int32)
+    return out
 
 
 def dedupe_edges_pair(edges: np.ndarray):

@@ -44,12 +44,20 @@ def prepare_edges(edges: np.ndarray, n_vertices: int | None = None) -> EdgeList:
     from tpu_distalg import native
 
     src, dst = native.dedupe_edges_pair(np.asarray(edges))  # distinct+sort
+    max_id = max(
+        int(src.max()) if len(src) else -1,
+        int(dst.max()) if len(dst) else -1,
+    )
     if n_vertices is None:
-        max_id = max(
-            int(src.max()) if len(src) else -1,
-            int(dst.max()) if len(dst) else -1,
-        )
         n_vertices = max_id + 1
+    elif n_vertices <= max_id:
+        # the native degree histogram indexes degree[src[i]] without a
+        # bounds check — an undersized count is a heap write, not an
+        # off-by-one metric
+        raise ValueError(
+            f"n_vertices={n_vertices} but the edge list references "
+            f"vertex id {max_id}; pass n_vertices >= {max_id + 1} or "
+            f"None to infer it")
     out_degree = native.out_degree(src, n_vertices)
     return EdgeList(
         src=src.astype(np.int32),
@@ -94,6 +102,34 @@ def contribs(
     graph-prep time instead of every sweep."""
     per_edge = ranks[src] * per_edge_weight
     return scatter_add(per_edge, dst, n, indices_sorted=indices_sorted)
+
+
+def decode_edge_rows(rows: jax.Array):
+    """Split packed ``(E, 3)`` int32 cache rows back into
+    ``(src, dst, w)`` — the device-side inverse of
+    ``native.pack_edge_rows`` (``csr_edge_blocks_i32`` layout: the f32
+    per-edge weight rides as its bit pattern so the block matrix stays
+    one dtype for the packed-cache format)."""
+    from jax import lax
+
+    return (rows[:, 0], rows[:, 1],
+            lax.bitcast_convert_type(rows[:, 2], jnp.float32))
+
+
+def block_contribs(ranks: jax.Array, rows: jax.Array, lo: jax.Array,
+                   window: int) -> jax.Array:
+    """One streamed edge block's rank contributions, scattered into the
+    owning shard's destination WINDOW: decode, gather ``ranks[src]·w``,
+    ``segment_sum`` onto ``dst − lo`` (``lo`` = the shard's first
+    destination id). Blocks are destination-sorted slices of a globally
+    dst-sorted edge list, so ``indices_are_sorted=True`` holds and
+    padding edges (zero weight, replicated last dst) are inert. The
+    window is the whole point: a shard's partials live in O(window)
+    instead of O(V), and the cross-shard combine can stay sparse
+    (``comms.sparse_allreduce``)."""
+    src, dst, w = decode_edge_rows(rows)
+    return scatter_add(ranks[src] * w, dst - lo, window,
+                       indices_sorted=True)
 
 
 def closure_step(paths: jax.Array, edges_bool: jax.Array) -> jax.Array:

@@ -127,6 +127,21 @@ SPMV_BLK = 8       # chunks per grid step
 SPMV_VMEM_BUDGET = 100 * 1024 * 1024
 
 
+def _emit_vmem_rejection(n_vertices: int, rg: int) -> None:
+    """Record a VMEM-budget plan rejection AND its remedy: the guard
+    used to just refuse, leaving the caller to discover the ~12M
+    resident ceiling from a docstring. The event (and the CLI's
+    warn-and-degrade built on ``models/pagerank.choose_data_backend``)
+    names the out-of-core engine instead."""
+    from tpu_distalg.telemetry import events as tevents
+
+    tevents.emit(
+        "spmv_vmem_rejected", n_vertices=int(n_vertices), rg=int(rg),
+        budget_bytes=SPMV_VMEM_BUDGET,
+        remedy="--data-backend streamed (tpu_distalg/graphs/: edge "
+               "blocks stream from disk, only O(V) state in HBM)")
+
+
 def spmv_resident_bytes(n_vertices: int, rg: int, ws: int,
                         blk: int = SPMV_BLK) -> int:
     """Kernel-resident VMEM bytes of an SpMV plan geometry: the ranks
@@ -305,6 +320,7 @@ def plan_spmv(src: np.ndarray, dst: np.ndarray, w_e: np.ndarray,
     # now so scatter='auto' degrades to the hybrid/XLA sweep instead
     # (ADVICE r5: the tables alone blow the budget at V≳12M).
     if spmv_resident_bytes(n_vertices, rg, 8, blk) > SPMV_VMEM_BUDGET:
+        _emit_vmem_rejection(n_vertices, rg)
         return None
     # groups = EVEN partitions of the table rows (a fixed rg-row stride
     # would leave a skinny remainder group whose few edges span the
@@ -371,6 +387,7 @@ def plan_spmv(src: np.ndarray, dst: np.ndarray, w_e: np.ndarray,
     if ws > SPMV_WS_CAP:
         return None
     if spmv_resident_bytes(n_vertices, rg, ws, blk) > SPMV_VMEM_BUDGET:
+        _emit_vmem_rejection(n_vertices, rg)
         return None  # actual ws confirmed the footprint overflow
     r8 = ((n_vertices + LANES - 1) // LANES + 7) // 8 * 8
     shape8 = (n_ch * 8, LANES)

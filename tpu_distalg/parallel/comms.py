@@ -834,6 +834,59 @@ def emit_sync_counters(sync: CommSync, n_syncs: int) -> dict:
     return st
 
 
+def rank_combine_stats(k: int, length: int, n: int) -> dict:
+    """Byte accounting for a window-sparse vector combine — the graph
+    engine's rank-contribution reduce (``graphs/engine.py``), where
+    every shard contributes ``k`` (value, index) pairs covering the
+    destination window its edge blocks touch.
+
+    ``bytes_wire`` is the sparse exchange's per-shard payload: the ring
+    all-gather of the pair buffers (:func:`sparse_allreduce`) moves
+    ``8k`` bytes per hop over ``n−1`` hops — on power-law graphs ``k``
+    (the shard's distinct-destination count) is a small fraction of the
+    vertex count, the observation Sparse Allreduce (arXiv:1312.3020)
+    is built on. ``bytes_dense_ring`` is what the dense alternative — a
+    psum of the O(length) zero-padded vector under a bandwidth-optimal
+    ring — would move: ``4·length·2(n−1)/n``. ``bytes_logical`` is the
+    f32 payload logically reduced (the dense length), so the standard
+    ``comm.bytes_wire``/``bytes_logical`` counters render the achieved
+    compression in ``tda report`` exactly like the gradient schedules'.
+    """
+    ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+    return {
+        "bytes_wire": int(8 * k * max(0, n - 1)),
+        "bytes_dense_ring": int(round(4 * length * ring)),
+        "bytes_logical": int(4 * length),
+        "rounds": 1,
+    }
+
+
+def emit_rank_combine_counters(k: int, length: int, n: int, *,
+                               n_syncs: int = 1,
+                               combine: str = "sparse") -> dict:
+    """Bump the telemetry counters for ``n_syncs`` rank combines and
+    return the per-sync accounting. ``comm.bytes_wire`` carries the
+    payload of the combine actually run (``combine='dense'`` runs the
+    psum, so its wire bytes are the dense-ring figure); the
+    ``graph.combine_*`` pair records BOTH accountings so the report can
+    state the sparse-vs-dense win for the run whichever was selected.
+    No-op when telemetry is disabled."""
+    from tpu_distalg.telemetry import events as tevents
+
+    st = rank_combine_stats(k, length, n)
+    wire = (st["bytes_wire"] if combine == "sparse"
+            else st["bytes_dense_ring"])
+    tevents.counter("comm.bytes_wire", wire * n_syncs)
+    tevents.counter("comm.bytes_logical", st["bytes_logical"] * n_syncs)
+    tevents.counter("comm.rounds", st["rounds"] * n_syncs)
+    tevents.counter("comm.syncs", n_syncs)
+    tevents.counter("graph.combine_bytes_wire", wire * n_syncs)
+    tevents.counter("graph.combine_bytes_dense_ring",
+                    st["bytes_dense_ring"] * n_syncs)
+    tevents.counter("graph.combine_syncs", n_syncs)
+    return st
+
+
 def emit_overlap_counters(hidden_ms: float, comm_ms: float) -> None:
     """Bump the overlap-efficiency counters ``tda report`` renders:
     ``comm.overlap_hidden_ms`` is comm time HIDDEN behind compute

@@ -235,6 +235,20 @@ def render(s: dict) -> str:
                 f"{s['counters'].get('comm.syncs', 0)} sync(s), "
                 f"{s['counters'].get('comm.rounds', 0)} collective "
                 f"round(s)")
+        gw = s["counters"].get("graph.combine_bytes_wire")
+        gdr = s["counters"].get("graph.combine_bytes_dense_ring")
+        if gw and gdr:
+            # the graph engine's sparse rank combine (graphs/engine.py
+            # via comms.emit_rank_combine_counters): pair-exchange
+            # bytes actually accounted vs what a dense O(V) ring psum
+            # of the rank vector would have moved — <1x means the
+            # graph was dense enough that combine='dense' was (or
+            # should have been) selected
+            lines.append(
+                f"graph rank combine: {gw} bytes wire vs {gdr} "
+                f"dense-ring equivalent ({gdr / gw:.1f}x sparser) over "
+                f"{s['counters'].get('graph.combine_syncs', 0)} "
+                f"sweep(s)")
         hid = s["counters"].get("comm.overlap_hidden_ms")
         exposed = s["counters"].get("comm.sync_ms")
         if hid is not None or exposed is not None:
