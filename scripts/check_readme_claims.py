@@ -86,6 +86,14 @@ CLAIMS = [
      r"int8 runs \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
     ("ssgd_comm_topk_step_speedup",
      r"topk \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
+    # online serving layer (round 13): throughput claimed as a floor
+    # and the scoring p99 as a CEILING until the first real-backend
+    # round records the achieved numbers (cpu-tagged fallback lines
+    # cannot serve as the reference)
+    ("serve_als_qps",
+     r"\*\*ALS serving[^*]*\*\*:\s*\*\*([\d\s.]+?)\+\s*req/s", 1.0),
+    ("serve_lr_p99_ms",
+     r"LR scoring p99 under \*\*([\d.]+?)\s*ms\*\*", 1.0),
 ]
 
 #: claims stated as FLOORS ("×+"): the measured value may exceed the
@@ -95,6 +103,14 @@ FLOOR_CLAIMS = frozenset((
     "ssgd_comm_int8_step_speedup",
     "ssgd_comm_topk_step_speedup",
     "pagerank_100m_iters_per_sec",
+    "serve_als_qps",
+))
+
+#: claims stated as CEILINGS ("under X ms" — latency metrics, lower is
+#: better): a measured value below the claim is the feature working;
+#: only a measured value tolerance-above the ceiling fails
+CEILING_CLAIMS = frozenset((
+    "serve_lr_p99_ms",
 ))
 
 
@@ -168,6 +184,11 @@ def main(argv=None) -> int:
             # one-sided: beating the floor is success, not drift
             bad = got < claim * (1.0 - args.tolerance)
             line += " [floor]"
+        elif metric in CEILING_CLAIMS:
+            # one-sided the other way: a latency under the ceiling is
+            # the feature working; only blowing through it fails
+            bad = got > claim * (1.0 + args.tolerance)
+            line += " [ceiling]"
         else:
             bad = abs(ratio - 1.0) > args.tolerance
         if bad:

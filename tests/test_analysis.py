@@ -626,6 +626,85 @@ def test_tda051_negative_native_ring_and_scope():
     assert lint(widened, path=LIB) == []  # parallel/ only
 
 
+# ---------------------------------------------------------------- TDA060
+
+SERVE = "tpu_distalg/serve/somemod.py"
+
+
+def test_tda060_unbounded_queue_flagged():
+    src = """
+    import queue
+
+    def make():
+        return queue.Queue()
+    """
+    assert codes(lint(src, path=SERVE)) == ["TDA060"]
+    spelled = """
+    import queue
+
+    a = queue.Queue(0)
+    b = queue.LifoQueue(maxsize=0)
+    c = queue.Queue(-1)
+    """
+    # maxsize <= 0 is documented-infinite: 0, -1 and the omitted arg
+    # are all the same grow-until-OOM shape
+    assert codes(lint(spelled, path=SERVE)) == ["TDA060"] * 3
+
+
+def test_tda060_blocking_get_without_timeout_flagged():
+    src = """
+    def loop(q):
+        while True:
+            handle(q.get())
+    """
+    assert codes(lint(src, path=SERVE)) == ["TDA060"]
+    explicit_block = """
+    def drain(q):
+        return q.get(True)
+    """
+    assert codes(lint(explicit_block, path=SERVE)) == ["TDA060"]
+    # a truthy numeric block arg is the same block-forever shape, and
+    # timeout=None is the SPELLED-OUT block-forever
+    numeric_and_none = """
+    def drain(q):
+        return q.get(1), q.get(timeout=None), q.get(True, None)
+    """
+    assert codes(lint(numeric_and_none, path=SERVE)) == ["TDA060"] * 3
+
+
+def test_tda060_negative_bounded_timeout_and_scope():
+    clean = """
+    import queue
+
+    def loop(depth):
+        q = queue.Queue(maxsize=depth)
+        try:
+            item = q.get(timeout=0.05)
+        except queue.Empty:
+            item = q.get_nowait()
+        return item, q.get(block=False), q.get(0)
+    """
+    assert lint(clean, path=SERVE) == []
+    # dict.get — non-numeric key — is not a queue wait; a real
+    # positional timeout is bounded; a numeric dict key with a
+    # non-None default stays exempt through the timeout check
+    dget = """
+    def lookup(d, q, key):
+        return (d.get(key, None), d.get(key), q.get(True, 0.05),
+                d.get(3, "fallback"))
+    """
+    assert lint(dget, path=SERVE) == []
+    # the rule is scoped to tpu_distalg/serve/ — elsewhere other
+    # disciplines own queue behavior (e.g. the Prefetcher guard)
+    outside = """
+    import queue
+
+    q = queue.Queue()
+    item = q.get()
+    """
+    assert lint(outside, path=LIB) == []
+
+
 # ------------------------------------------------- suppressions / TDA000
 
 

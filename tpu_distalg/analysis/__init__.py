@@ -26,6 +26,10 @@ TDA051      no dtype-widening cast on a quantized buffer as it enters
             a collective in ``tpu_distalg/parallel/`` — compressed
             payloads ride the wire natively (the int32-psum wire
             PR 5 documented and round 11 removed stays removed)
+TDA060      no unbounded ``queue.Queue()`` and no blocking ``get()``
+            without a timeout in ``tpu_distalg/serve/`` — the serving
+            layer sheds under overload and always observes its stop
+            flag (liveness discipline, the Prefetcher guard's shape)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
@@ -47,11 +51,13 @@ from tpu_distalg.analysis.engine import (
 )
 from tpu_distalg.analysis.pallas import RULES as _PALLAS
 from tpu_distalg.analysis.seams import RULES as _SEAMS
+from tpu_distalg.analysis.serve import RULES as _SERVE
 from tpu_distalg.analysis.tracing import RULES as _TRACING
 
 #: every shipped rule, in code order
 RULES = tuple(sorted(
-    _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS,
+    _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
+    + _SERVE,
     key=lambda r: r.code))
 
 __all__ = [

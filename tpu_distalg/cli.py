@@ -318,6 +318,49 @@ def main(argv=None):
                         "after the final sweep — each pass re-reads R)")
     _add_ckpt(p, 5)
 
+    p = sub.add_parser(
+        "serve",
+        help="micro-batched online serving from checkpointed "
+             "artifacts: bounded queue -> deadline-or-size dispatch -> "
+             "one batched predict per micro-batch -> scatter replies; "
+             "ALS top-k rides the fused Pallas matmul+top-k kernel "
+             "with model-axis-sharded item factors; runs a closed-loop "
+             "demo load and prints qps/p50/p99")
+    p.add_argument("--artifact", action="append", required=True,
+                   metavar="PATH",
+                   help="checkpoint directory to serve (repeatable); "
+                        "training CLIs run with --checkpoint-dir print "
+                        "the machine-readable 'artifact_path: PATH' "
+                        "line this flag consumes")
+    p.add_argument("--n-slices", type=int, default=0,
+                   help="data-axis size; 0 = all devices")
+    p.add_argument("--model-slices", type=int, default=1,
+                   help="mesh model-axis size: ALS item factors are "
+                        "sharded across it; per-shard top-k candidates "
+                        "merge via the --comm schedule")
+    p.add_argument("--max-batch", type=int, default=16,
+                   help="dispatch a micro-batch at this many requests")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="... or this many ms after its first request")
+    p.add_argument("--queue-depth", type=int, default=128,
+                   help="bounded request queue; a full queue SHEDS "
+                        "(reply carries ServeOverloadError) instead of "
+                        "growing or dying")
+    p.add_argument("--k-top", type=int, default=10,
+                   help="ALS recommendations per request")
+    p.add_argument("--comm", default="sparse",
+                   choices=["sparse", "dense"],
+                   help="ALS cross-shard candidate merge: sparse = "
+                        "ring all-gather of each shard's k (value, "
+                        "index) pairs (8k(S-1) B/request), dense = "
+                        "all-gather of the full score blocks (the O(N) "
+                        "baseline)")
+    p.add_argument("--requests", type=int, default=256,
+                   help="closed-loop demo load per served model")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop worker count")
+    _add_telemetry(p)
+
     p = sub.add_parser("mc", help="Monte-Carlo pi")
     p.add_argument("--n-slices", type=int, default=0)
     p.add_argument("--n", type=int, default=400_000)
@@ -334,7 +377,8 @@ def main(argv=None):
              "equal (rc 1 on mismatch)")
     p.add_argument("--workload", default="lr",
                    choices=["lr", "ssgd", "kmeans", "als",
-                            "kmeans_stream", "pagerank_stream"])
+                            "kmeans_stream", "pagerank_stream",
+                            "serve"])
     p.add_argument("--n-slices", type=int, default=0)
     p.add_argument("--n-iterations", type=int, default=None,
                    help="override the workload's small default")
@@ -868,6 +912,15 @@ def _dispatch(args, jax):
         # TDA011 polices); values print bitwise-identically
         for t, e in enumerate(np.asarray(res.rmse_history)):
             print(f"iterations: {t}, rmse: {float(e):f}")
+        if args.checkpoint_dir:
+            # machine-readable artifact handoff: `tda serve --artifact`
+            # consumes this exact line (and the telemetry event) — no
+            # directory globbing needed to find where the factors went
+            from tpu_distalg.telemetry import events as tevents
+
+            tevents.emit("artifact_path", workload="als",
+                         path=args.checkpoint_dir)
+            print(f"artifact_path: {args.checkpoint_dir}")
 
     elif args.cmd == "chaos":
         import os
@@ -909,6 +962,56 @@ def _dispatch(args, jax):
                           f"{workdir}", file=sys.stderr)
         print(res.verdict())
         return 0 if res.equal else 1
+
+    elif args.cmd == "serve":
+        import numpy as np
+
+        from tpu_distalg import serve as serve_pkg
+        from tpu_distalg.parallel import MeshContext
+        from tpu_distalg.serve.server import run_closed_loop
+
+        mesh = MeshContext.create(
+            data=args.n_slices if args.n_slices > 0 else None,
+            model=args.model_slices).mesh
+        cfg = serve_pkg.ServeConfig(
+            max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+            queue_depth=args.queue_depth, k_top=args.k_top,
+            merge=args.comm)
+        server = serve_pkg.Server(mesh, cfg)
+        try:
+            for path in args.artifact:
+                model = server.add_artifact(path)
+                print(f"[serve] {model.kind} model {model.name!r} from "
+                      f"{path} (meta: {model.meta})")
+            rng = np.random.default_rng(0)
+            for name, model in server.models.items():
+                if model.kind == "als":
+                    n_users = max(1, model.meta["n_users"])
+                    payloads = [np.int32(int(v) % n_users)
+                                for v in rng.integers(
+                                    0, n_users, size=args.requests)]
+                elif model.kind == "kmeans":
+                    payloads = list(rng.normal(size=(
+                        args.requests, model.meta["dim"])
+                    ).astype(np.float32))
+                else:
+                    payloads = list(rng.normal(size=(
+                        args.requests, model.meta["d"])
+                    ).astype(np.float32))
+                _, info = run_closed_loop(
+                    server, name, payloads,
+                    concurrency=args.concurrency, retries=2)
+                print(f"[serve] {name}: {info['ok']}/{len(payloads)} "
+                      f"replies at {info['qps']} req/s (closed loop, "
+                      f"{info['concurrency']} workers, "
+                      f"{info['retries']} retries)")
+            s = server.emit_counters()
+            print(f"[serve] total: {s['replies']} replies in "
+                  f"{s['batches']} micro-batch(es), p50 {s['p50_ms']} "
+                  f"ms / p99 {s['p99_ms']} ms, {s['shed']} shed, max "
+                  f"queue depth {s['max_queue_depth']}")
+        finally:
+            server.close()
 
     elif args.cmd == "mc":
         from tpu_distalg.models import monte_carlo as m

@@ -27,6 +27,15 @@ is the fault schedule, not the FLOPs:
                      path runs through the same ``data:gather`` /
                      ``data:h2d`` seams, checkpointed so a mid-sweep
                      fault resumes the power iteration bitwise
+  ``serve``          the online serving layer (``tpu_distalg/serve/``)
+                     answering a fixed request sequence: artifact load
+                     runs through the ``ckpt:read`` seam (transient
+                     corruption re-read, never a demoted model) and
+                     every micro-batch dispatch through ``data:gather``
+                     (an injected failure fails THAT batch's replies,
+                     the server keeps serving, the closed-loop client
+                     retries) — recovery is shed-and-retry, and the
+                     final reply set must still be bitwise-identical
 
 Used three ways: the ``tda chaos`` CLI subcommand (rc 1 on any
 mismatch), ``tests/test_faults.py``'s acceptance grid, and ad-hoc
@@ -44,12 +53,26 @@ from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
 
 WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream",
-             "pagerank_stream")
+             "pagerank_stream", "serve")
 
 # enough restarts to survive a multi-fault schedule without masking a
 # deterministic bug forever (a fault that keeps re-firing on @* rules
 # still exhausts this and fails loudly)
 DEFAULT_MAX_RESTARTS = 3
+
+
+@dataclasses.dataclass
+class ServeChaosResult:
+    """The serve workload's comparison surface: the stacked replies for
+    the fixed request sequence, plus the degradation evidence (sheds /
+    failed batches / client retries) the test asserts actually
+    happened. Only ``replies`` enters the bitwise compare — degradation
+    COUNTS legitimately differ between runs; the replies must not."""
+
+    replies: np.ndarray
+    shed: int
+    failed_batches: int
+    client_retries: int
 
 
 @dataclasses.dataclass
@@ -84,6 +107,8 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
                 "rmse_history": np.asarray(res.rmse_history)}
     if workload == "pagerank_stream":
         return {"ranks": np.asarray(res.ranks)}
+    if workload == "serve":
+        return {"replies": np.asarray(res.replies)}
     raise ValueError(f"unknown chaos workload {workload!r}; choose from "
                      f"{WORKLOADS}")
 
@@ -181,6 +206,49 @@ def _make_runner(workload: str, mesh, n_iterations: int | None,
                 gd, cfg, checkpoint_dir=ckpt_dir,
                 checkpoint_every=every)
         return run
+    if workload == "serve":
+        import os
+
+        from tpu_distalg.models import logistic_regression as lrm
+        from tpu_distalg.utils import datasets
+
+        # the artifact is trained ONCE, outside both runs (its write
+        # path has its own ckpt:write chaos coverage) — the chaos
+        # surface here is the serving stack: artifact LOAD (ckpt:read)
+        # and micro-batch dispatch (data:gather)
+        data = datasets.breast_cancer_split()
+        artifact_dir = os.path.join(workdir, "artifact")
+        lrm.train(*data, mesh,
+                  lrm.LRConfig(n_iterations=n_iterations or 30),
+                  checkpoint_dir=artifact_dir, checkpoint_every=10)
+        X_req = np.asarray(data[2], np.float32)[:24]  # fixed test rows
+
+        def run(ckpt_dir):
+            del ckpt_dir  # recovery = shed + client retry, no resume
+            from tpu_distalg import serve as serve_pkg
+            from tpu_distalg.serve.server import run_closed_loop
+
+            srv = serve_pkg.Server(mesh, serve_pkg.ServeConfig(
+                max_batch=4, max_delay_ms=2.0, queue_depth=8))
+            try:
+                srv.add_artifact(artifact_dir, name="lr")
+                results, info = run_closed_loop(
+                    srv, "lr", list(X_req), concurrency=2, retries=8,
+                    retry_backoff_s=0.01)
+                if info["failed"]:
+                    # out of retry budget — restartable, not a verdict
+                    raise RuntimeError(
+                        f"serve chaos: {info['failed']} request(s) "
+                        f"still failed after retries")
+                st = srv.stats()
+                return ServeChaosResult(
+                    replies=np.stack([np.asarray(r) for r in results]),
+                    shed=st["shed"],
+                    failed_batches=st["failed_batches"],
+                    client_retries=info["retries"])
+            finally:
+                srv.close()
+        return run
     raise ValueError(f"unknown chaos workload {workload!r}; choose from "
                      f"{WORKLOADS}")
 
@@ -204,16 +272,22 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     if isinstance(plan, str):
         plan = faults.FaultPlan.parse(plan)
     log = logger or (lambda m: None)
+    # injection OFF before ANY experiment I/O, not just the reference
+    # run: the serve runner trains its artifact inside _make_runner,
+    # and an ambient registry armed by the caller must not corrupt the
+    # shared artifact or consume its own hit counters out of schedule
+    faults.configure(False)
     runner = _make_runner(workload, mesh, n_iterations, checkpoint_every,
                           workdir)
-    uses_ckpt = workload != "kmeans_stream"
+    # kmeans_stream recovers by deterministic re-run, serve by
+    # shed-and-client-retry — neither consumes a checkpoint dir
+    uses_ckpt = workload not in ("kmeans_stream", "serve")
 
     def dirpath(name):
         d = os.path.join(workdir, name)
         return d if uses_ckpt else None
 
-    # undisturbed reference first — injection OFF whatever the env says
-    faults.configure(False)
+    # undisturbed reference first
     tevents.mark("chaos:reference", emit_event=False)
     ref = runner(dirpath("ref"))
 

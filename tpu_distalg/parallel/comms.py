@@ -276,6 +276,15 @@ def _ring_allgather(buf, axis_name: str, n: int):
     return acc
 
 
+#: public name for the origin-placed ring all-gather: the serving
+#: layer's sharded top-k candidate merge rides the SAME pair exchange
+#: the topk gradient schedule and sparse_allreduce do (each shard
+#: contributes its k (value, index) pairs — ``8k(n−1)`` wire bytes per
+#: sync instead of an O(length) dense gather), so a hop-ordering fix
+#: can never land in one rider and not another
+ring_allgather = _ring_allgather
+
+
 def _ring_allreduce(v, axis_name: str, n: int):
     """Bandwidth-optimal ring allreduce of a flat ``(n·chunk,)`` f32
     vector: n−1 reduce-scatter steps then n−1 all-gather steps, all

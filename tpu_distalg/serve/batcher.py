@@ -1,0 +1,269 @@
+"""Request-level micro-batching: bounded queue → deadline-or-size
+dispatch → one batched predict → scatter replies.
+
+Liveness discipline (the same contract ``data/pipeline.Prefetcher``
+earned, now lint-enforced by TDA060 for this package): the request
+queue is BOUNDED — a full queue sheds the request with
+:class:`ServeOverloadError` instead of growing without limit — and
+every blocking ``get`` carries a timeout, so the dispatch thread can
+always observe the stop flag and a wedged producer can never hang the
+server silently.
+
+Host-sync discipline (TDA011's invariant, applied to serving): the
+dispatch loop performs exactly ONE device synchronization per BATCH —
+the predictor's single ``np.asarray`` fetch — never one per request.
+Replies are scattered host-side from that one fetched array.
+
+Fault seams: staging a micro-batch is the serving analogue of a data
+gather, so dispatch runs through the existing ``data:gather`` injection
+point — an injected (or real) failure fails THAT batch's replies and
+the loop keeps serving (``tda chaos --workload serve`` proves requests
+retried after a shed/failed batch recover bitwise-identical replies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+
+from tpu_distalg import faults
+from tpu_distalg.telemetry import events as tevents
+
+#: idle poll interval for the dispatch loop's first-request wait: the
+#: bound that lets the loop re-check the stop flag (a bare blocking
+#: get() could sleep forever on an idle server — the TDA060 shape)
+POLL_SECONDS = 0.05
+
+#: latency samples kept per batcher (enough for stable p99 at bench
+#: scale; a long-lived server keeps the newest window)
+MAX_LATENCY_SAMPLES = 200_000
+
+
+class ServeOverloadError(RuntimeError):
+    """The bounded request queue is full — this request was SHED.
+
+    Shedding is the degrade-not-die contract: the server stays live and
+    the client decides (retry with backoff, or drop). Carried inside
+    the :class:`Reply` rather than raised at ``submit`` so every
+    request has a uniform reply-side error surface."""
+
+
+class ServeClosedError(RuntimeError):
+    """The batcher is shutting down; the request was not served."""
+
+
+class Reply:
+    """One request's reply slot: a threading.Event the dispatch thread
+    resolves exactly once with a value or an error. ``latency_s`` is
+    submit→resolve wall time (monotonic), recorded for the p50/p99
+    stats."""
+
+    __slots__ = ("_event", "_value", "_error", "_t_submit", "latency_s")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+        self._t_submit = time.perf_counter()
+        self.latency_s: float | None = None
+
+    def _resolve(self, value=None, error: BaseException | None = None):
+        self.latency_s = time.perf_counter() - self._t_submit
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> BaseException | None:
+        """The reply's error (None while pending or on success) —
+        non-raising inspection for shed-aware clients."""
+        return self._error
+
+    def result(self, timeout: float = 30.0):
+        """Wait (bounded) for the reply; raises the request's error
+        (e.g. :class:`ServeOverloadError` when shed)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"no reply within {timeout}s — server wedged or closed?")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclasses.dataclass
+class BatcherStats:
+    """Mutated only under the owning batcher's lock; read via
+    :meth:`MicroBatcher.snapshot`."""
+
+    requests: int = 0
+    replies: int = 0
+    batches: int = 0
+    shed: int = 0
+    failed_batches: int = 0
+    failed_requests: int = 0
+    max_queue_depth: int = 0
+    latencies_s: list = dataclasses.field(default_factory=list)
+
+
+class MicroBatcher:
+    """One served model's queue + dispatch loop.
+
+    ``predict(payloads)`` receives the list of raw request payloads
+    (1 ≤ len ≤ ``max_batch``) and returns one reply value per payload;
+    it owns the pad-to-jit-stable-shape and the single per-batch host
+    sync (``serve/artifacts.py`` builds it). Dispatch fires when the
+    batch hits ``max_batch`` OR ``max_delay_ms`` has passed since the
+    batch's first request — a lone request is never parked waiting for
+    traffic that may not come (the deadline test pins it).
+    """
+
+    def __init__(self, name: str, predict, *, max_batch: int = 16,
+                 max_delay_ms: float = 5.0, queue_depth: int = 128):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1, got {queue_depth}")
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.queue_depth = int(queue_depth)
+        self._predict = predict
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._lock = threading.Lock()
+        self._stats = BatcherStats()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-batch-{name}")
+        self._thread.start()
+
+    # ------------------------------------------------------------- API
+
+    def submit(self, payload) -> Reply:
+        """Enqueue one request. Never blocks: a full queue SHEDS the
+        request (reply resolves with :class:`ServeOverloadError`) — the
+        bounded-queue degrade contract."""
+        reply = Reply()
+        if self._stop.is_set():
+            reply._resolve(error=ServeClosedError(
+                f"{self.name}: batcher closed"))
+            return reply
+        try:
+            self._q.put_nowait((payload, reply))
+        except queue.Full:
+            with self._lock:
+                self._stats.shed += 1
+            tevents.counter("serve.shed")
+            tevents.emit("serve_shed", model=self.name,
+                         queue_depth=self.queue_depth)
+            reply._resolve(error=ServeOverloadError(
+                f"{self.name}: request queue full "
+                f"(depth {self.queue_depth}) — shed; retry with backoff"))
+            return reply
+        if self._stop.is_set():
+            # close() raced past the check above between our stop check
+            # and the put: its drain may already be done, so nobody
+            # would ever read this entry — sweep the queue ourselves
+            # (every drained reply resolves exactly once: each queue
+            # item is popped by exactly one drainer)
+            self._drain_closed()
+            return reply
+        with self._lock:
+            self._stats.requests += 1
+            depth = self._q.qsize()
+            if depth > self._stats.max_queue_depth:
+                self._stats.max_queue_depth = depth
+        return reply
+
+    def snapshot(self) -> BatcherStats:
+        with self._lock:
+            return dataclasses.replace(
+                self._stats, latencies_s=list(self._stats.latencies_s))
+
+    def close(self, timeout: float = 10.0):
+        """Stop the dispatch loop (drains in-flight work first), then
+        fail anything still queued with :class:`ServeClosedError`."""
+        self._stop.set()
+        self._thread.join(timeout)
+        self._drain_closed()
+
+    def _drain_closed(self):
+        """Fail everything queued after the stop flag is up. Shared by
+        :meth:`close` and the ``submit`` race path (a request enqueued
+        between close()'s stop-set and its drain must not hang until
+        the client's reply timeout)."""
+        while True:
+            try:
+                _, reply = self._q.get_nowait()
+            except queue.Empty:
+                break
+            reply._resolve(error=ServeClosedError(
+                f"{self.name}: batcher closed with request queued"))
+
+    # ---------------------------------------------------- dispatch loop
+
+    def _loop(self):
+        while True:
+            try:
+                first = self._q.get(timeout=POLL_SECONDS)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.max_delay_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break  # deadline hit with a partial batch
+            self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        payloads = [p for p, _ in batch]
+        replies = [r for _, r in batch]
+        try:
+            with tevents.span("serve:batch", model=self.name,
+                              n=len(batch)):
+                # staging the micro-batch is the serving analogue of a
+                # data gather — same chaos seam, same degrade proof
+                faults.inject("data:gather")
+                out = self._predict(payloads)
+        except Exception as e:  # noqa: BLE001 — a batch failure must
+            #                     never kill the dispatch loop: fail
+            #                     THESE replies, keep serving
+            with self._lock:
+                self._stats.batches += 1
+                self._stats.failed_batches += 1
+                self._stats.failed_requests += len(batch)
+            # a failed batch was still a DISPATCHED batch: keep the
+            # report-line counters in step with BatcherStats.batches
+            tevents.counter("serve.requests", len(batch))
+            tevents.counter("serve.batches")
+            tevents.counter("serve.failed_batches")
+            tevents.emit("serve_batch_failed", model=self.name,
+                         n=len(batch), error=f"{type(e).__name__}: {e}")
+            for r in replies:
+                r._resolve(error=e)
+            return
+        for r, value in zip(replies, out):
+            r._resolve(value=value)
+        with self._lock:
+            self._stats.batches += 1
+            self._stats.replies += len(batch)
+            lat = self._stats.latencies_s
+            for r in replies:
+                lat.append(r.latency_s)
+            if len(lat) > MAX_LATENCY_SAMPLES:
+                del lat[:len(lat) - MAX_LATENCY_SAMPLES]
+        tevents.counter("serve.requests", len(batch))
+        tevents.counter("serve.batches")

@@ -249,6 +249,20 @@ def render(s: dict) -> str:
                 f"dense-ring equivalent ({gdr / gw:.1f}x sparser) over "
                 f"{s['counters'].get('graph.combine_syncs', 0)} "
                 f"sweep(s)")
+        sreq = s["counters"].get("serve.requests")
+        if sreq:
+            # the serving layer's latency line (serve/server.py
+            # emit_counters): request/batch/shed counters + the
+            # qps/p50/p99/queue-depth gauges of the newest run
+            g = s["gauges"]
+            shed = s["counters"].get("serve.shed", 0)
+            lines.append(
+                f"serve: {sreq} request(s) in "
+                f"{s['counters'].get('serve.batches', 0)} "
+                f"micro-batch(es), {g.get('serve.qps', '?')} req/s, "
+                f"p50 {g.get('serve.p50_ms', '?')} ms / "
+                f"p99 {g.get('serve.p99_ms', '?')} ms, {shed} shed, "
+                f"max queue depth {g.get('serve.queue_depth', '?')}")
         hid = s["counters"].get("comm.overlap_hidden_ms")
         exposed = s["counters"].get("comm.sync_ms")
         if hid is not None or exposed is not None:

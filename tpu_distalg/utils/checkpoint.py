@@ -208,12 +208,14 @@ def quarantine(path: str, *, logger=None) -> bool:
     return True
 
 
-def _restore_newest_with_fallback(ckpt_dir: str, *, logger=None):
+def restore_newest_with_fallback(ckpt_dir: str, *, logger=None):
     """The resume read path: try the newest checkpoint; a corrupt one is
     quarantined IN-PROCESS and the next-older step is tried — recovery
     from the crash-corrupts-newest-checkpoint scenario costs zero
     restart budget. Returns ``(payload, step)`` or ``None`` when no
-    restorable checkpoint remains (fresh start)."""
+    restorable checkpoint remains (fresh start). Public: the serving
+    layer's artifact loader degrades through the same path
+    (``serve/artifacts.py``)."""
     while True:
         step = latest_step(ckpt_dir)
         if step is None:
@@ -254,7 +256,7 @@ def run_segmented(
     threads the absolute step offset into its PRNG (``t0``), segmented
     and straight-through runs are bitwise-identical. A corrupt newest
     checkpoint is quarantined and the next-older step resumes instead
-    (see :func:`_restore_newest_with_fallback`).
+    (see :func:`restore_newest_with_fallback`).
 
     ``make_seg_fn(seg_len)`` builds (and caches per distinct length) the
     compiled segment; ``run_seg(fn, state, t0)`` executes it and returns
@@ -285,7 +287,7 @@ def run_segmented(
     start = 0
     accs_parts = []
     state = state0
-    restored = _restore_newest_with_fallback(checkpoint_dir)
+    restored = restore_newest_with_fallback(checkpoint_dir)
     if restored is not None:
         payload, start = restored
         if start > n_iterations:
