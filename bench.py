@@ -622,6 +622,197 @@ def _bench_comm_speedup(mesh, n_chips):
     run_comm_step_speedup(mesh, _emit)
 
 
+#: the canonical seeded straggler plan the SSP headline is pinned to:
+#: each (tick, shard) cell independently straggles with p=0.25, paying
+#: SSP_STRAGGLE_UNITS of injected interference compute (real FLOPs
+#: inside the program — ssp.straggle_work); the plan string is recorded
+#: in the bench line so the number replays from its inputs
+SSP_STRAGGLE_UNITS = 800
+SSP_STRAGGLE_PLAN = (
+    f"seed=7;shard:straggle@p0.25=straggle:{SSP_STRAGGLE_UNITS}")
+#: staleness bound of the canonical SSP measurement (ticks per window)
+SSP_STALENESS = 8
+#: convergence-band width for the equal-loss comparison (accuracy
+#: points below the BSP endpoint that still count as "reached")
+SSP_CONV_BAND = 0.01
+
+
+def run_ssp_straggler_speedup(mesh, emit, *, steps=64, repeats=3,
+                              conv_iters=600, staleness=None):
+    """The SSP headline pair (ROADMAP item 2's acceptance evidence),
+    shared by the bench ``ssp`` phase and the CPU-fallback tier:
+
+    ``ssgd_ssp_straggler_speedup`` — FULL measured step time, BSP vs
+    SSP, under the canonical seeded straggler plan at the canonical
+    :data:`COMM_CANONICAL_SHARDS` geometry (the ``run_comm_step_speedup``
+    shape). Both arms pay the identical compiled-in interference
+    schedule; BSP's per-tick psum barrier serializes every shard's
+    delay while SSP's window structure overlaps them — the ratio is
+    the stall time the bounded-staleness layer removes, measured, not
+    accounted. Unlike the comm-compression lines, this one is honest
+    ON a host mesh too: the straggle delay is real compute on the
+    straggling device-thread, and the BSP barrier really waits for it.
+
+    ``ssgd_ssp_equal_loss_steps`` — the convergence cost of the
+    asynchrony: steps SSP needs to reach the BSP endpoint accuracy
+    minus :data:`SSP_CONV_BAND` on the converging comm-comparison
+    task, as a ratio of BSP's own steps-to-target (SSP evaluates at
+    window boundaries, so its step count is window-quantized).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_distalg import faults as tfaults
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.parallel import parallelize
+    from tpu_distalg.parallel import ssp as pssp
+    from tpu_distalg.utils import profiling
+
+    n_shards = int(mesh.shape["data"])
+    if n_shards < 2:
+        return  # no barrier exists for a straggler to serialize
+    s_bound = staleness or SSP_STALENESS
+    # the PR 6 convention, extended: the canonical claim names are
+    # reserved for the canonical (shard count, staleness bound)
+    # geometry — any other measurement records under a suffixed name
+    # so it can never overwrite the claims/tripwire reference
+    name_suffix = ""
+    if n_shards != COMM_CANONICAL_SHARDS:
+        name_suffix += f"_at_{n_shards}shards"
+    if s_bound != SSP_STALENESS:
+        name_suffix += f"_bound{s_bound}"
+    plan = tfaults.FaultPlan.parse(SSP_STRAGGLE_PLAN)
+    sync_spelling = f"ssp:{s_bound}"
+    X, y, X_te, y_te = comm_comparison_task()
+    d = X.shape[1]
+    Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
+    dummy_te = (jnp.zeros((1, d), jnp.float32),
+                jnp.zeros((1,), jnp.float32))
+    w0 = jnp.zeros((d,), jnp.float32)
+    n_win, padded = pssp.window_grid(steps, s_bound)
+    extra = pssp.compile_straggle_schedule(padded, n_shards, plan=plan)
+    extra[steps:] = 0  # pad ticks don't exist (mirrors _train_ssp):
+    # neither interference nor boundary-busy may leak from the padding
+    # of a non-divisible off-canonical bound
+
+    # -- BSP arm: the classic per-tick psum trainer + the schedule --
+    cfg = ssgd.SSGDConfig(n_iterations=steps, eval_test=False)
+    bsp_fn = ssgd.make_bsp_straggler_fn(mesh, cfg, Xs.n_padded, extra)
+    bsp_rate, bsp_spread = profiling.steps_per_sec(
+        lambda: bsp_fn(Xs.data, ys.data, Xs.mask, *dummy_te, w0),
+        steps=steps, repeats=repeats, with_stats=True)
+
+    # -- SSP arm: same schedule, merges once per window --
+    cfg_ssp = ssgd.SSGDConfig(n_iterations=steps, eval_test=False,
+                              sync=sync_spelling)
+    ssp_fn = ssgd.make_ssp_train_fn(
+        mesh, cfg_ssp, Xs.n_padded, d,
+        active=(True,) * n_shards, n_win_seg=n_win,
+        total_ticks=steps)
+    # the carry comes from the trainer's own init helper — the bench
+    # measures the state layout the trainer actually ships
+    _, clocks0, pend0, basegen0, wl0, accd0, res0 = \
+        ssgd.ssp_init_state(mesh, cfg_ssp, d, w=np.asarray(w0))
+    shard2 = NamedSharding(mesh, P("data", None))
+    wl0 = jax.device_put(jnp.asarray(wl0), shard2)
+    accd0 = jax.device_put(jnp.asarray(accd0), shard2)
+    res0 = jax.device_put(jnp.asarray(res0), shard2)
+    clocks0, pend0, basegen0 = (jnp.asarray(clocks0),
+                                jnp.asarray(pend0),
+                                jnp.asarray(basegen0))
+    extra_seg = jnp.asarray(extra.reshape(n_win, s_bound, n_shards))
+    ssp_rate, ssp_spread = profiling.steps_per_sec(
+        lambda: ssp_fn(Xs.data, ys.data, Xs.mask, *dummy_te, w0,
+                       clocks0, pend0, basegen0, wl0, accd0, res0,
+                       extra_seg, jnp.int32(0)),
+        steps=steps, repeats=repeats, with_stats=True)
+
+    pssp.emit_stall_avoided(steps / bsp_rate, steps / ssp_rate, steps)
+    line = {
+        "metric": "ssgd_ssp_straggler_speedup",
+        "value": round(ssp_rate / bsp_rate, 3),
+        "unit": "x",
+        "vs_baseline": None,
+        "ssp_steps_per_sec": round(ssp_rate, 2),
+        "bsp_steps_per_sec": round(bsp_rate, 2),
+        "staleness_bound": s_bound,
+        "straggle_plan": SSP_STRAGGLE_PLAN,
+        "straggled_cells": int(np.count_nonzero(extra)),
+        "steps": steps, "n_shards": n_shards,
+        "bsp_spread": bsp_spread, "spread": ssp_spread,
+        "note": "full measured step time under the SAME compiled-in "
+                "seeded interference schedule; BSP's per-tick barrier "
+                "pays every shard's delay serially, SSP's window "
+                "overlaps them — real on host meshes too (the delay "
+                "is real compute, the barrier really waits)",
+    }
+    line["metric"] += name_suffix
+    emit(line)
+
+    # -- convergence: steps to the BSP endpoint band (no faults) --
+    conv_bsp = ssgd.SSGDConfig(n_iterations=conv_iters)
+    bsp_res = ssgd.train(X, y, X_te, y_te, mesh, conv_bsp)
+    conv_ssp = ssgd.SSGDConfig(n_iterations=conv_iters,
+                               sync=sync_spelling)
+    ssp_res = ssgd.train(X, y, X_te, y_te, mesh, conv_ssp)
+    bsp_accs = np.asarray(bsp_res.accs)
+    ssp_accs = np.asarray(ssp_res.accs)
+    target = float(bsp_accs[-1]) - SSP_CONV_BAND
+
+    def first_reach(accs):
+        idx = np.nonzero(accs >= target)[0]
+        return int(idx[0]) + 1 if idx.size else None
+
+    bsp_steps = first_reach(bsp_accs) or conv_iters
+    ssp_steps = first_reach(ssp_accs)
+    if ssp_steps is None:
+        # the serve-phase lesson (round 13, review round 3): a
+        # fabricated 0.0 would read as PERFECT to the lower-is-better
+        # tripwire and the ceiling claim, and poison the reference —
+        # raise (the phase is optional) instead of emitting
+        raise RuntimeError(
+            f"ssp never reached the BSP band (target {target:.4f}, "
+            f"ssp final {float(ssp_accs[-1]):.4f}) in {conv_iters} "
+            f"steps — investigate before a ratio can be claimed")
+    ratio = ssp_steps / bsp_steps
+    line = {
+        "metric": "ssgd_ssp_equal_loss_steps",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": None,
+        "target_acc": round(target, 6),
+        "bsp_final_acc": round(float(bsp_accs[-1]), 6),
+        "ssp_final_acc": round(float(ssp_accs[-1]), 6),
+        "bsp_steps_to_target": bsp_steps,
+        "ssp_steps_to_target": ssp_steps,
+        "staleness_bound": s_bound,
+        "n_iterations": conv_iters, "n_shards": n_shards,
+        "note": "steps to reach (BSP endpoint − band) as a ratio of "
+                "BSP's own; SSP evaluates at window boundaries, so "
+                "its count is window-quantized; faults-free run — the "
+                "straggled-convergence evidence is tda chaos "
+                "--workload ssp",
+    }
+    line["metric"] += name_suffix
+    emit(line)
+
+
+def _bench_ssp(mesh, n_chips, sync="bsp"):
+    """The SSP straggler phase — see
+    :func:`run_ssp_straggler_speedup`. ``--sync ssp:s`` overrides the
+    measured staleness bound; off-default bounds record under
+    ``_bound{s}``-suffixed metric names so the canonical claim metric
+    can never be overwritten (the PR 6 shard-suffix convention)."""
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(sync)
+    run_ssp_straggler_speedup(
+        mesh, _emit,
+        staleness=spec.staleness if spec.is_ssp else None)
+
+
 def _bench_ssgd(mesh, on_tpu, n_chips, comm="dense"):
     import jax
     import jax.numpy as jnp
@@ -1923,6 +2114,8 @@ ALL_METRIC_NAMES = (
     "ssgd_comm_topk_wire_reduction_vs_dense",
     "ssgd_comm_int8_step_speedup",
     "ssgd_comm_topk_step_speedup",
+    "ssgd_ssp_straggler_speedup",
+    "ssgd_ssp_equal_loss_steps",
     "ssgd_lr_100m_rows_steps_per_sec_per_chip",
     "ssgd_lr_1b_rows_virtual_steps_per_sec_per_chip",
     "ssgd_lr_32gb_streamed_steps_per_sec_per_chip",
@@ -1942,9 +2135,11 @@ ALL_METRIC_NAMES = (
     "serve_lr_p99_ms",
 )
 
-#: metrics where LOWER is better (latencies): the regression tripwire
-#: flags these on a >15% RISE, and never flags an improvement
-LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",))
+#: metrics where LOWER is better (latencies; the SSP steps-to-target
+#: ratio): the regression tripwire flags these on a >15% RISE, and
+#: never flags an improvement
+LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",
+                                     "ssgd_ssp_equal_loss_steps"))
 
 #: canonical units, for the skipped-with-zero lines
 _METRIC_UNITS = {
@@ -1959,6 +2154,8 @@ _METRIC_UNITS = {
     "ssgd_comm_topk_wire_reduction_vs_dense": "x",
     "ssgd_comm_int8_step_speedup": "x",
     "ssgd_comm_topk_step_speedup": "x",
+    "ssgd_ssp_straggler_speedup": "x",
+    "ssgd_ssp_equal_loss_steps": "x",
     "ring_attention_32k_tokens_per_sec_per_chip": "tokens/s/chip",
     "ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip":
         "tokens/s/chip",
@@ -2250,6 +2447,12 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
         functools.partial(
             run_comm_step_speedup, mesh, _cpu_emit,
             **(dict(d=1 << 14, steps=4, repeats=1) if fast else {})))
+    _phase_optional(
+        "cpu_ssp",
+        functools.partial(
+            run_ssp_straggler_speedup, mesh, _cpu_emit,
+            **(dict(steps=16, repeats=1, conv_iters=48)
+               if fast else {})))
     _phase_optional("cpu_pagerank", cpu_pagerank)
     _phase_optional("cpu_pagerank_streamed", cpu_pagerank_streamed)
     _phase_optional(
@@ -2290,6 +2493,15 @@ def main(argv=None):
                              "(default), bucketed, hier, bf16, int8, "
                              "topk[:frac]. The comm-comparison phase "
                              "records all schedules regardless")
+    parser.add_argument("--sync", default="bsp", metavar="MODE",
+                        help="staleness bound for the ssp phase "
+                             "(parallel/ssp.py): 'bsp' measures at the "
+                             "canonical bound, 'ssp:s' overrides it — "
+                             "the BSP-vs-SSP straggler A/B runs either "
+                             "way; off-default bounds emit under "
+                             "_boundN-suffixed metric names so the "
+                             "canonical claim metric is never "
+                             "overwritten")
     args = parser.parse_args(argv)
 
     tevents.configure(args.telemetry_dir)
@@ -2352,6 +2564,10 @@ def _run(args):
                                    n_chips, args.comm)
             _phase("comm", _bench_comm, mesh, n_chips)
             _phase("comm_speedup", _bench_comm_speedup, mesh, n_chips)
+            # optional: run_ssp_straggler_speedup raises rather than
+            # emitting a fabricated 0.0 ratio when SSP misses the band
+            _phase_optional("ssp", _bench_ssp, mesh, n_chips,
+                            args.sync)
             if on_tpu:
                 _phase("ssgd_100m", _bench_ssgd_scale, mesh, n_chips)
                 _phase("ssgd_1b_virtual", _bench_ssgd_virtual, mesh,

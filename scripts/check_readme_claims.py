@@ -86,6 +86,15 @@ CLAIMS = [
      r"int8 runs \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
     ("ssgd_comm_topk_step_speedup",
      r"topk \*\*([\d.]+?)×\+\*\* the dense step rate", 1.0),
+    # stale-synchronous pair (round 14): measured straggler speedup is
+    # a floor, honest on host meshes too (the injected interference is
+    # real compute and the BSP barrier really waits); the equal-loss
+    # steps ratio is a CEILING (lower = converges like BSP)
+    ("ssgd_ssp_straggler_speedup",
+     r"SSP runs \*\*([\d.]+?)×\+\*\* the BSP step rate", 1.0),
+    ("ssgd_ssp_equal_loss_steps",
+     r"BSP-endpoint accuracy\s+within \*\*([\d.]+?)×\*\* the steps",
+     1.0),
     # online serving layer (round 13): throughput claimed as a floor
     # and the scoring p99 as a CEILING until the first real-backend
     # round records the achieved numbers (cpu-tagged fallback lines
@@ -104,6 +113,7 @@ FLOOR_CLAIMS = frozenset((
     "ssgd_comm_topk_step_speedup",
     "pagerank_100m_iters_per_sec",
     "serve_als_qps",
+    "ssgd_ssp_straggler_speedup",
 ))
 
 #: claims stated as CEILINGS ("under X ms" — latency metrics, lower is
@@ -111,6 +121,7 @@ FLOOR_CLAIMS = frozenset((
 #: only a measured value tolerance-above the ceiling fails
 CEILING_CLAIMS = frozenset((
     "serve_lr_p99_ms",
+    "ssgd_ssp_equal_loss_steps",
 ))
 
 

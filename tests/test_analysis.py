@@ -705,6 +705,77 @@ def test_tda060_negative_bounded_timeout_and_scope():
     assert lint(outside, path=LIB) == []
 
 
+# ---------------------------------------------------------------- TDA070
+
+PAR = "tpu_distalg/parallel/somemod.py"
+
+
+def test_tda070_unseeded_schedule_rng_flagged():
+    src = """
+    import numpy as np
+
+    def make(n_ticks, n_shards):
+        straggle_schedule = np.random.default_rng().integers(
+            0, 2, (n_ticks, n_shards))
+        return straggle_schedule
+    """
+    # TDA001 (unseeded RNG in library code) fires too — TDA070 adds
+    # the schedule-specific diagnosis
+    assert "TDA070" in codes(lint(src, path=PAR))
+    module_draw = """
+    import numpy as np
+
+    membership_plan = np.random.rand(8, 4)
+    """
+    assert "TDA070" in codes(lint(module_draw, path=PAR))
+
+
+def test_tda070_clock_wait_without_deadline_flagged():
+    src = """
+    def wait_for(clocks, target):
+        while clocks.min() < target:
+            pass
+    """
+    assert codes(lint(src, path=PAR)) == ["TDA070"]
+
+
+def test_tda070_negative_seeded_bounded_and_scoped():
+    clean = """
+    import numpy as np
+
+    def make(n_ticks, n_shards, seed):
+        rng = np.random.default_rng(seed)
+        straggle_schedule = rng.integers(0, 2, (n_ticks, n_shards))
+        return straggle_schedule
+
+    def wait_for(clocks, target, deadline_s, now):
+        while clocks.min() < target and now() < deadline_s:
+            pass
+
+    def plain_loop(items):
+        while items:
+            items.pop()
+    """
+    assert lint(clean, path=PAR) == []
+    # non-schedule names and non-parallel paths are out of scope
+    outside = """
+    import numpy as np
+
+    def wait_for(clocks, target):
+        while clocks.min() < target:
+            pass
+    """
+    assert lint(outside, path=LIB) == []
+    unrelated_name = """
+    import numpy as np
+
+    def noise(n, seed):
+        jitter = np.random.default_rng(seed).random(n)
+        return jitter
+    """
+    assert lint(unrelated_name, path=PAR) == []
+
+
 # ------------------------------------------------- suppressions / TDA000
 
 

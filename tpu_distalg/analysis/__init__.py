@@ -30,6 +30,12 @@ TDA060      no unbounded ``queue.Queue()`` and no blocking ``get()``
             without a timeout in ``tpu_distalg/serve/`` — the serving
             layer sheds under overload and always observes its stop
             flag (liveness discipline, the Prefetcher guard's shape)
+TDA070      SSP discipline in ``tpu_distalg/parallel/``: no unseeded
+            RNG feeding a staleness/straggle/membership/epoch
+            schedule (the bitwise-replay contract of the
+            stale-synchronous layer), and no unbounded host-side wait
+            on the clock vector (a departed shard's frozen clock must
+            time out, not wedge)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
@@ -52,12 +58,13 @@ from tpu_distalg.analysis.engine import (
 from tpu_distalg.analysis.pallas import RULES as _PALLAS
 from tpu_distalg.analysis.seams import RULES as _SEAMS
 from tpu_distalg.analysis.serve import RULES as _SERVE
+from tpu_distalg.analysis.ssp import RULES as _SSP
 from tpu_distalg.analysis.tracing import RULES as _TRACING
 
 #: every shipped rule, in code order
 RULES = tuple(sorted(
     _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
-    + _SERVE,
+    + _SERVE + _SSP,
     key=lambda r: r.code))
 
 __all__ = [

@@ -28,7 +28,8 @@ def _mesh(args):
     ).mesh
 
 
-def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
+def _add_common(p, n_iterations, eta=None, frac=None, samplers=None,
+                sync=False):
     p.add_argument("--n-slices", type=int, default=0,
                    help="data-axis size; 0 = all devices")
     p.add_argument("--n-iterations", type=int, default=n_iterations)
@@ -52,6 +53,25 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None):
                  "for the single-bucket topk/hier). Emits "
                  "comm.bytes_wire/bytes_logical/rounds telemetry "
                  "counters per run")
+    if sync:
+        # stale-synchronous & elastic training (parallel/ssp.py +
+        # parallel/membership.py) — the SGD-family trainers only
+        p.add_argument(
+            "--sync", default="bsp", metavar="MODE",
+            help="synchronization discipline: bsp (lock-step, one "
+                 "collective per step/round — bitwise the classic "
+                 "trainer; default) or ssp[:s[:decay]] (stale-"
+                 "synchronous: shards run up to s steps ahead of the "
+                 "slowest, the merge runs once per s-tick window with "
+                 "staleness-weighted averaging / delayed gradients, "
+                 "and a clock vector gates bound violations — a "
+                 "straggler no longer serializes every step). Seeded "
+                 "shard:straggle / shard:leave --fault-plan rules "
+                 "compile into deterministic straggler and elastic-"
+                 "membership schedules; the same plan replays bitwise. "
+                 "A checkpointed ssp run resumed with a different "
+                 "--n-slices renegotiates the ring (membership epoch) "
+                 "instead of rejecting")
     if frac is not None:
         p.add_argument("--mini-batch-fraction", type=float, default=frac)
         # TPU perf knobs (see ssgd.SSGDConfig.sampler for semantics);
@@ -178,7 +198,7 @@ def main(argv=None):
     p = sub.add_parser("ssgd", help="synchronous minibatch SGD")
     _add_common(p, 1500, eta=0.1, frac=0.1,
                 samplers=["bernoulli", "fixed", "fused", "fused_gather",
-                          "fused_train"])
+                          "fused_train"], sync=True)
     p.add_argument("--lam", type=float, default=0.0)
     p.add_argument("--reg-type", default="l2",
                    choices=["none", "l2", "l1", "elastic_net"])
@@ -201,7 +221,7 @@ def main(argv=None):
         _add_common(p, 1500 if name == "easgd" else 300, eta=0.1,
                     frac=0.1,
                     samplers=["bernoulli", "fused_gather",
-                              "fused_train"])
+                              "fused_train"], sync=True)
         p.add_argument("--n-local-iterations", type=int,
                        default=1 if name == "easgd" else 5)
         p.add_argument("--resample-per-local-step", action="store_true")
@@ -378,7 +398,7 @@ def main(argv=None):
     p.add_argument("--workload", default="lr",
                    choices=["lr", "ssgd", "kmeans", "als",
                             "kmeans_stream", "pagerank_stream",
-                            "serve"])
+                            "serve", "ssp"])
     p.add_argument("--n-slices", type=int, default=0)
     p.add_argument("--n-iterations", type=int, default=None,
                    help="override the workload's small default")
@@ -534,6 +554,10 @@ def _dispatch(args, jax):
                     "--comm applies to the in-memory trainers; the "
                     "streamed trainer (--stream-cache) stages blocks "
                     "host->device per step and syncs dense")
+            if args.sync != "bsp":
+                raise SystemExit(
+                    "--sync ssp applies to the in-memory trainers; "
+                    "the streamed trainer (--stream-cache) runs BSP")
             n_shards = int(mesh.shape["data"])
             X2, meta, (X_te, y_te) = datasets.streamed_packed_cache(
                 args.stream_cache, n_rows=args.stream_rows,
@@ -565,7 +589,7 @@ def _dispatch(args, jax):
                 gather_block_rows=args.gather_block_rows,
                 fused_pack=args.fused_pack,
                 shuffle_seed=args.shuffle_seed,
-                comm=args.comm)
+                comm=args.comm, sync=args.sync)
             if args.sampler != "fused_train" and \
                     args.mega_steps is not None:
                 raise SystemExit(
@@ -640,7 +664,7 @@ def _dispatch(args, jax):
                         gather_block_rows=args.gather_block_rows,
                         fused_pack=args.fused_pack,
                         shuffle_seed=args.shuffle_seed,
-                        comm=args.comm),
+                        comm=args.comm, sync=args.sync),
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every)
         from tpu_distalg.utils import checkpoint as ckpt

@@ -29,6 +29,7 @@ from tpu_distalg.faults.registry import (
     configure,
     enabled,
     inject,
+    probe,
 )
 
 __all__ = [
@@ -47,5 +48,6 @@ __all__ = [
     "enabled",
     "inject",
     "preempt",
+    "probe",
     "registry",
 ]

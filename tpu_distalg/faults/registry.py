@@ -14,6 +14,15 @@ recovered state is BITWISE-equal to an undisturbed run.
 
 Injection points (wired at the call sites named):
 
+  ``shard:straggle``  SSP schedule compilation
+                    (``parallel/ssp.compile_straggle_schedule``) — one
+                    probe per (tick, shard) in fixed row-major order,
+                    so rule ``@N`` addresses invocation
+                    ``tick·n_shards + shard``
+  ``shard:leave``   elastic-membership epoch compilation
+                    (``parallel/membership.compile_epochs``) — one
+                    probe per (window boundary, shard), same ordering
+
   ``ckpt:write``    ``utils/checkpoint.save`` — the bytes about to land
                     on disk (``corrupt`` really flips file bytes; the
                     CRC footer catches it on restore)
@@ -49,6 +58,16 @@ Fault kinds:
                 dies WITHOUT posting (the silent-death failure mode its
                 consumer guard exists for); everywhere else it
                 propagates as a restartable ``RuntimeError``.
+  ``straggle``  a SCHEDULING kind (``shard:straggle`` only): the
+                matched (tick, shard) cell spends the tick on ``arg``
+                units of injected interference compute instead of a
+                logical training step. Consumed via :func:`probe` by
+                the SSP schedule compiler — it never raises; the
+                straggle cost is paid inside the compiled program.
+  ``leave``     a SCHEDULING kind (``shard:leave`` only): the matched
+                (boundary, shard) cell leaves the active membership for
+                ``arg`` windows (default 2) and rejoins after. Consumed
+                via :func:`probe` by the membership epoch compiler.
 
 Plan spec (CLI ``--fault-plan`` / env ``$TDA_FAULT_PLAN``) — either a
 path to a JSON file (``{"seed": 42, "rules": [{"point": ..., "hit":
@@ -89,12 +108,22 @@ POINTS = (
     "data:h2d",
     "backend:init",
     "segment:run",
+    "shard:straggle",
+    "shard:leave",
 )
 
-KINDS = ("oserror", "hang", "corrupt", "kill")
+KINDS = ("oserror", "hang", "corrupt", "kill", "straggle", "leave")
+
+#: the SCHEDULING kinds: they fire at schedule-compilation seams via
+#: :func:`probe` (which returns the rule instead of raising) — the
+#: fault itself plays out inside the compiled SSP program, bitwise-
+#: replayable because the schedule is a pure function of the plan
+_SCHEDULING_KINDS = {"straggle": "shard:straggle", "leave": "shard:leave"}
 
 DEFAULT_HANG_SECONDS = 0.05
 DEFAULT_CORRUPT_BYTES = 8
+DEFAULT_STRAGGLE_UNITS = 200
+DEFAULT_LEAVE_WINDOWS = 2
 
 
 class InjectedOSError(OSError):
@@ -143,6 +172,17 @@ class FaultRule:
                 f"fault probability must be in (0, 1], got {self.prob}")
         if self.hit is not None and self.hit < 0:
             raise ValueError(f"fault hit index must be >= 0, got {self.hit}")
+        want_point = _SCHEDULING_KINDS.get(self.kind)
+        if want_point is not None and self.point != want_point:
+            raise ValueError(
+                f"scheduling kind {self.kind!r} fires at the "
+                f"{want_point!r} point only (got {self.point!r})")
+        if self.point in _SCHEDULING_KINDS.values() \
+                and want_point is None:
+            raise ValueError(
+                f"point {self.point!r} takes scheduling kinds only "
+                f"({', '.join(sorted(_SCHEDULING_KINDS))}), got "
+                f"{self.kind!r}")
 
     def spec(self) -> str:
         where = (f"p{self.prob}" if self.prob is not None
@@ -218,9 +258,14 @@ class FaultRegistry:
     the record of every fault fired (``fired`` — what the chaos suite
     and the replay-determinism check compare)."""
 
-    def __init__(self, plan: FaultPlan, *, sleep=time.sleep):
+    def __init__(self, plan: FaultPlan, *, sleep=time.sleep,
+                 quiet: bool = False):
         self.plan = plan
         self._sleep = sleep
+        self._quiet = quiet  # no telemetry: the plan-pure scratch
+        #                      registries the SSP schedule compilers
+        #                      probe (fires reach telemetry exactly
+        #                      once, via the live ledger's record())
         self._lock = threading.Lock()
         self._hits: dict[str, int] = {}
         self._rngs: dict[str, random.Random] = {}
@@ -245,9 +290,11 @@ class FaultRegistry:
                 chosen = rule
         return chosen
 
-    def inject(self, point: str, payload=None):
-        """The one call every injection point makes. Returns ``payload``
-        (possibly corrupted); may raise or stall per the plan."""
+    def _consume(self, point: str):
+        """One invocation of ``point``: bump the counter, match, record
+        and emit. Returns ``(rule | None, hit)`` — shared by
+        :meth:`inject` (acts the fault out) and :meth:`probe` (returns
+        the schedule entry)."""
         if point not in POINTS:
             raise ValueError(
                 f"unknown injection point {point!r}; valid points: "
@@ -258,12 +305,36 @@ class FaultRegistry:
             rule = self._match(point, hit)
             if rule is not None:
                 self.fired.append((point, hit, rule.kind))
+        if rule is not None and not self._quiet:
+            tevents.emit("fault_injected", point=point, hit=hit,
+                         kind=rule.kind, arg=rule.arg)
+            tevents.counter("faults.injected")
+            tevents.counter(f"faults.{rule.kind}")
+        return rule, hit
+
+    def probe(self, point: str):
+        """Schedule-compilation seam: consume one invocation of
+        ``point`` and return ``(kind, arg)`` when a rule fires, else
+        ``None`` — no exception, no stall. The SSP straggle/membership
+        compilers call this once per (tick, shard) cell in fixed order,
+        so the same plan always compiles the same schedule (the
+        property the bitwise-replay acceptance rests on)."""
+        rule, _ = self._consume(point)
+        if rule is None:
+            return None
+        return rule.kind, rule.arg
+
+    def inject(self, point: str, payload=None):
+        """The one call every injection point makes. Returns ``payload``
+        (possibly corrupted); may raise or stall per the plan."""
+        rule, hit = self._consume(point)
         if rule is None:
             return payload
-        tevents.emit("fault_injected", point=point, hit=hit,
-                     kind=rule.kind, arg=rule.arg)
-        tevents.counter("faults.injected")
-        tevents.counter(f"faults.{rule.kind}")
+        if rule.kind in _SCHEDULING_KINDS:
+            # scheduling kinds act inside the compiled SSP program, not
+            # at an I/O seam — an inject() here records the fire (the
+            # replay ledger stays complete) and passes through
+            return payload
         if rule.kind == "oserror":
             raise InjectedOSError(
                 f"[fault] injected transient OSError at {point}#{hit}")
@@ -292,6 +363,25 @@ class FaultRegistry:
         for _ in range(max(1, n_bytes)):
             buf[rng.randrange(len(buf))] ^= 0xFF
         return bytes(buf)
+
+    def record(self, fires) -> list:
+        """Mirror externally-observed fires into this registry's
+        ledger — the SSP schedule compilers probe a FRESH plan-pure
+        QUIET registry (so restarts recompile identically without
+        re-emitting), and the fires reach the chaos verdict and the
+        telemetry JSONL exactly once here: a (point, hit, kind) triple
+        already in the ledger (a restart's recompilation of the same
+        schedule) is skipped. Returns the newly recorded fires."""
+        with self._lock:
+            seen = set(self.fired)
+            new = [f for f in fires if f not in seen]
+            self.fired.extend(new)
+        for point, hit, kind in new:
+            tevents.emit("fault_injected", point=point, hit=hit,
+                         kind=kind, arg=None)
+            tevents.counter("faults.injected")
+            tevents.counter(f"faults.{kind}")
+        return new
 
     def hits(self, point: str) -> int:
         with self._lock:
@@ -346,3 +436,14 @@ def inject(point: str, payload=None):
     if reg is None:
         return payload
     return reg.inject(point, payload)
+
+
+def probe(point: str):
+    """Module-level schedule probe (see :meth:`FaultRegistry.probe`):
+    ``(kind, arg)`` when a rule fires on this invocation, else ``None``
+    — and always ``None`` with no plan configured, so an unfaulted SSP
+    run compiles empty straggle/membership schedules."""
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.probe(point)

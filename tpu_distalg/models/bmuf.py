@@ -9,7 +9,11 @@ MA plus a block-level momentum filter on the averaged update:
 Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
 ``comm='int8'``/``'topk'``/... compresses the round-end average on the
 native wire, with the bucket-overlap pipeline on by default (``@seq``
-disables — bitwise-identical).
+disables — bitwise-identical). Likewise the sync discipline:
+``sync='ssp[:s]'`` applies the block-momentum filter once per
+``s``-round window to the STALENESS-WEIGHTED average (straggled
+replicas down-weighted by ``decay^age`` instead of stalling the mesh;
+seeded ``shard:straggle``/``shard:leave`` plan rules, bitwise replay).
 """
 
 from __future__ import annotations

@@ -9,7 +9,12 @@ derived from the actual mesh size at build time unless overridden.
 Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
 ``comm='int8'``/``'topk'``/... compresses the round-end blend's average
 on the native wire, with the bucket-overlap pipeline on by default
-(``@seq`` disables — bitwise-identical).
+(``@seq`` disables — bitwise-identical). Likewise the sync discipline:
+``sync='ssp[:s]'`` blends the center once per ``s``-round window
+against the staleness-weighted replica average — a natural fit for
+EASGD, whose replicas already never resync and tolerate a stale center
+through the elastic pull (seeded ``shard:straggle``/``shard:leave``
+plan rules drive the straggler/membership schedules, bitwise replay).
 """
 
 from __future__ import annotations

@@ -85,6 +85,16 @@ class LocalSGDConfig:
     # this family is the round-end model average, so every sampler
     # (megakernel included) composes with it.
     comm: str = "dense"
+    # synchronization discipline (parallel/ssp.py): 'bsp' (lock-step
+    # round combine — bitwise the pre-SSP trainer, the default) or
+    # 'ssp[:s[:decay]]': the combine runs once per s-round window,
+    # replicas straggled by the seeded 'shard:straggle' plan skip
+    # rounds instead of stalling the mesh, and the merge is a
+    # STALENESS-WEIGHTED model average (weight decay**windows-stale)
+    # feeding the usual MA/BMUF/EASGD center update. 'shard:leave'
+    # plan rules drive elastic membership epochs. Composes with the
+    # 'bernoulli' sampler; the fused kernels stay BSP.
+    sync: str = "bsp"
 
 
 @dataclasses.dataclass
@@ -173,6 +183,311 @@ def _make_combine(config: LocalSGDConfig, beta: float):
         raise ValueError(config.global_update)
 
     return combine
+
+
+def _check_sync_sampler(config: LocalSGDConfig) -> None:
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    if spec.is_ssp and config.sampler != "bernoulli":
+        raise ValueError(
+            f"sync={config.sync!r} (stale-synchronous) composes with "
+            f"the 'bernoulli' sampler — got sampler="
+            f"{config.sampler!r}; the fused kernels stay BSP")
+
+
+def make_ssp_train_fn(mesh: Mesh, config: LocalSGDConfig,
+                      n_padded: int, d: int, *,
+                      active: tuple[bool, ...], n_win_seg: int,
+                      total_rounds: int):
+    """SSP window scan for the local-update family: ``s`` ROUNDS of
+    ``L`` local steps each between combines. A replica straggled by the
+    seeded schedule skips the round (real interference compute runs
+    instead); the window-end merge is a staleness-weighted MODEL
+    average — every active replica's model enters with weight
+    ``decay**windows_stale`` (0 = it worked this window and was free at
+    the boundary) — feeding the usual MA/BMUF/EASGD center update. With
+    ``resync``, replicas adopt the fresh center at the window start
+    unless straggled there (a busy replica keeps its stale model — that
+    IS the staleness being weighted).
+
+    Call as ``fn(X, y, valid, X_test, y_test, w0, ws0, delta0,
+    clocks0, stale0, res0, extra_seg, win0)``; returns ``(w, ws,
+    delta, clocks, stale, res, win_accs, ages_max, ages_mean,
+    gated)``."""
+    import numpy as np
+
+    from jax import lax
+
+    from tpu_distalg.parallel import comms
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    s = spec.staleness
+    L = config.n_local_iterations
+    n_replicas = mesh.shape[DATA_AXIS]
+    beta = _derive_beta(config, n_replicas)
+    sync = _comm_sync(mesh, config, d)
+    combine = _make_combine(config, beta)
+    key = prng.root_key(config.seed)
+    active_np = np.asarray(active, bool)
+    big = jnp.int32(1 << 30)
+
+    def window_body(X, y, masks, w, ws_local, clocks, stale, res,
+                    extra, roundv, winid):
+        my = lax.axis_index(DATA_AXIS)
+        act = jnp.asarray(active_np)
+        act_me = act[my]
+        w_l = ws_local[0]
+        # resync adoption at the window start — a replica straggled at
+        # the boundary keeps its old model (the staleness the merge
+        # weights); EASGD never resyncs (easgd.py:95-106)
+        if config.resync:
+            adopt = act & (extra[0] == 0)
+        else:
+            adopt = jnp.zeros_like(act)
+        w_l = jnp.where(adopt[my], w, w_l)
+        max_c = jnp.max(jnp.where(act, clocks, -big))
+        clocks_adj = jnp.where(adopt, max_c, clocks)
+        min_known = jnp.min(jnp.where(act, clocks_adj, big))
+
+        def one_round(carry, xs):
+            w_l, my_clock, gated_ct = carry
+            masks_r, extra_r, rv = xs
+            # pad rounds pay no interference (cf. ssgd's tick body)
+            eu = jnp.where(rv, extra_r[my], 0)
+            gated = (my_clock - min_known) >= jnp.int32(s)
+            do = rv & act_me & (eu == 0) & jnp.logical_not(gated)
+            dummy = pssp.straggle_work(eu, 1.0)
+
+            def local_step(w_i, mask):
+                g_sum, cnt = logistic.grad_sum(X, y, w_i, mask)
+                g_mean = g_sum / jnp.maximum(cnt, 1.0)
+                return (w_i - config.eta * g_mean
+                        - config.elastic_alpha * (w_i - w)), None
+
+            w_new, _ = jax.lax.scan(local_step, w_l, masks_r)
+            w_l = pssp.entangle(
+                jnp.where(do, w_new, w_l), dummy)
+            my_clock = my_clock + do.astype(clocks.dtype)
+            gated_ct = gated_ct + (rv & act_me & gated).astype(
+                jnp.int32)
+            return (w_l, my_clock, gated_ct), None
+
+        (w_l, my_clock, my_gated), _ = lax.scan(
+            one_round, (w_l, clocks_adj[my], jnp.int32(0)),
+            (masks, extra, roundv))
+
+        clocks_new = comms.psum(
+            jnp.zeros_like(clocks).at[my].set(my_clock))
+        gated = comms.psum(my_gated)
+        stepped = clocks_new > clocks_adj
+        fresh = act & stepped & jnp.logical_not(extra[-1] > 0)
+        stale_new = jnp.where(fresh, 0, stale + 1)
+        wts = pssp.staleness_weights(
+            stale_new, act, act, spec.decay)
+        wsum = jnp.sum(wts)
+        contrib = wts[my] * w_l
+        (contrib,), res_new = sync.reduce((contrib,), res, winid)
+        w_avg = contrib / jnp.maximum(wsum, jnp.float32(1e-12))
+        ages_obs = jnp.where(act, stale_new, 0)
+        n_act = jnp.sum(act.astype(jnp.float32))
+        ages_max = jnp.max(ages_obs).astype(jnp.float32)
+        ages_mean = (jnp.sum(ages_obs.astype(jnp.float32))
+                     / jnp.maximum(n_act, 1.0))
+        return (w_l[None], w_avg, clocks_new, stale_new, res_new,
+                ages_max, ages_mean, gated)
+
+    window_fn = data_parallel(
+        window_body, mesh,
+        in_specs=(
+            P("data", None),        # X rows
+            P("data"),              # y
+            P(None, None, "data"),  # masks (s, L, rows)
+            P(),                    # center w
+            P("data", None),        # per-replica models (R, D)
+            P(), P(),               # clocks, stale (replicated)
+            P("data", None),        # error-feedback residual
+            P(), P(), P(),          # extra (s, S), round validity, win
+        ),
+        out_specs=(P("data", None), P(), P(), P(), P("data", None),
+                   P(), P(), P()),
+    )
+
+    def round_masks(valid, t):
+        if config.resample_per_local_step:
+            draws = [
+                sampling.bernoulli_mask(
+                    key, t * L + li, n_padded,
+                    config.mini_batch_fraction, valid)
+                for li in range(L)
+            ]
+            return jnp.stack(draws)
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid)
+        return jnp.broadcast_to(mask, (L, n_padded))
+
+    def train(X, y, valid, X_test, y_test, w0, ws0, delta0, clocks0,
+              stale0, res0, extra_seg, win0):
+        def win_step(carry, xs):
+            w, ws, delta, clocks, stale, res = carry
+            i, extra_w = xs
+            winid = (win0 + i).astype(jnp.int32)
+            ts = winid * s + jnp.arange(s)
+            masks = jax.vmap(lambda t: round_masks(valid, t))(ts)
+            roundv = ts < total_rounds
+            (ws, w_avg, clocks, stale, res, amax, amean,
+             gated) = window_fn(X, y, masks, w, ws, clocks, stale,
+                                res, extra_w, roundv, winid)
+            w, delta = combine(w, w_avg, delta)
+            acc = (metrics.binary_accuracy(X_test @ w, y_test)
+                   if config.eval_test else jnp.float32(0))
+            return ((w, ws, delta, clocks, stale, res),
+                    (acc, amax, amean, gated))
+
+        carry, (accs, amax, amean, gated) = jax.lax.scan(
+            win_step, (w0, ws0, delta0, clocks0, stale0, res0),
+            (jnp.arange(n_win_seg), extra_seg))
+        return (*carry, accs, amax, amean, gated)
+
+    return jax.jit(train)
+
+
+def _train_ssp(
+    X_train, y_train, X_test, y_test, mesh: Mesh,
+    config: LocalSGDConfig,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 100,
+) -> TrainResult:
+    """SSP driver for the local-update family — the ssgd driver's
+    shape over (w, ws, delta, clocks, stale, res) state, elastic via
+    :func:`membership.run_elastic` (a resume at a different shard
+    count re-derives per-replica state from the replicated center)."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.models.ssgd import window_accs_to_ticks
+    from tpu_distalg.parallel import comms, membership
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    s = spec.staleness
+    T = config.n_iterations
+    D = X_train.shape[1]
+    n_shards = int(mesh.shape[DATA_AXIS])
+    Xs = parallelize(X_train, mesh, dtype=jnp.dtype(config.x_dtype))
+    ys = parallelize(y_train, mesh)
+    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+    k_init = prng.root_key(config.init_seed)
+    w0 = np.asarray(logistic.init_weights(
+        jax.random.fold_in(k_init, 0), D), np.float32)
+    ws0 = np.asarray(jax.random.uniform(
+        jax.random.fold_in(k_init, 1), (n_shards, D),
+        minval=-1.0, maxval=1.0), np.float32)
+    if config.global_update == "bmuf" and config.random_delta_init:
+        delta0 = np.asarray(jax.random.uniform(
+            jax.random.fold_in(k_init, 2), (D,),
+            minval=-1.0, maxval=1.0), np.float32)
+    else:
+        delta0 = np.zeros((D,), np.float32)
+    n_win, padded = pssp.window_grid(T, s)
+    extra = pssp.compile_straggle_schedule(padded, n_shards)
+    extra[T:] = 0  # pad rounds don't exist: no interference, no busy
+    extra = extra.reshape(n_win, s, n_shards)
+    sync = _comm_sync(mesh, config, D)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def fresh_shard_state(w_host):
+        """Per-replica state derived from the replicated center — the
+        renegotiation story: a rejoining replica starts at the center,
+        residuals are re-zeroed (flushed into the last merge)."""
+        w_host = np.asarray(w_host, np.float32)
+        return (np.tile(w_host, (n_shards, 1)),
+                np.asarray(sync.init_state()))
+
+    def renegotiate(saved_leaves, saved_shards, start_win):
+        del saved_shards, start_win
+        w = np.asarray(saved_leaves[0], np.float32)
+        ws_new, res_new = fresh_shard_state(w)
+        return (w, ws_new,
+                np.asarray(saved_leaves[2], np.float32),   # delta
+                membership.redistribute_clocks(saved_leaves[3],
+                                               n_shards),
+                np.zeros((n_shards,), np.int32),           # stale
+                res_new)
+
+    def make_seg_fn(active, n_win_seg):
+        return make_ssp_train_fn(
+            mesh, config, Xs.n_padded, D, active=active,
+            n_win_seg=n_win_seg, total_rounds=T)
+
+    def on_epoch(state, prev, cur):
+        """A shard re-entering the active set is CURRENT, not a
+        straggler: its clock froze while it was away (history, not
+        staleness), and for EASGD (resync=False) no in-program adopt
+        exists to bump it — left alone, the frozen clock would become
+        min_known and the gate would serialize the whole mesh onto the
+        rejoiner. Its model's genuine staleness is still carried (and
+        merge-weighted) by `stale`, which only resets once it does
+        fresh work."""
+        w, ws, delta, clocks, stale, res = state
+        clocks = np.asarray(clocks, np.int32).copy()
+        rejoined = [k for k in range(n_shards)
+                    if cur.active[k] and not prev.active[k]]
+        if rejoined:
+            cont = [k for k in range(n_shards)
+                    if cur.active[k] and prev.active[k]]
+            top = int(clocks[cont].max()) if cont \
+                else int(clocks.max())
+            clocks[rejoined] = top
+        return (w, ws, delta, clocks, stale, res)
+
+    def run_seg(fn, state, win0, n_win_seg, epoch):
+        del epoch
+        w, ws, delta, clocks, stale, res = state
+        ws = jax.device_put(jnp.asarray(np.asarray(ws)), shard2)
+        res = jax.device_put(jnp.asarray(np.asarray(res)), shard2)
+        out = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
+                 jnp.asarray(np.asarray(w, np.float32)), ws,
+                 jnp.asarray(np.asarray(delta, np.float32)),
+                 jnp.asarray(np.asarray(clocks, np.int32)),
+                 jnp.asarray(np.asarray(stale, np.int32)),
+                 res, jnp.asarray(extra[win0:win0 + n_win_seg]),
+                 jnp.int32(win0))
+        return out[:6], out[6:]
+
+    # state layout: (w, ws, delta, clocks, stale, res)
+    state0 = (w0, ws0, delta0, np.zeros((n_shards,), np.int32),
+              np.zeros((n_shards,), np.int32),
+              np.asarray(sync.init_state()))
+
+    state, outs, start, epochs = membership.run_elastic(
+        checkpoint_dir, max(1, checkpoint_every // s), n_win,
+        n_shards, make_seg_fn=make_seg_fn, run_seg=run_seg,
+        state0=state0, renegotiate=renegotiate, on_epoch=on_epoch,
+        # spec.spec() in the tag: window indexing and merge weights
+        # depend on (s, decay) — a different --sync must reject, not
+        # silently reinterpret the saved window progress
+        tag=(f"local_sgd:{spec.spec()}:{config.global_update}"
+             f":comm={config.comm}"),
+        ticks_per_window=s)
+
+    w = jnp.asarray(np.asarray(state[0], np.float32))
+    ws = jnp.asarray(np.asarray(state[1], np.float32))
+    metrics.guard_finite((w, ws), "local-SGD (ssp) models")
+    accs = window_accs_to_ticks(outs[0], s, T) if outs \
+        else np.zeros((T,), np.float32)
+    stats = pssp.observed_staleness(
+        outs[1] if outs else [], outs[2] if outs else [])
+    pssp.emit_ssp_counters(
+        spec, stats,
+        straggle_ticks=int(np.count_nonzero(extra)),
+        gated_ticks=int(np.asarray(outs[3]).sum()) if outs else 0,
+        epochs=len(epochs))
+    comms.emit_sync_counters(sync, n_win - start)
+    return TrainResult(w=w, ws=ws, accs=jnp.asarray(accs))
 
 
 def make_train_fn(mesh: Mesh, config: LocalSGDConfig, n_padded: int,
@@ -659,6 +974,14 @@ def train(
     # progress mark: the heartbeat names this phase if a round wedges
     # (checkpointed runs also mark per segment inside run_segmented)
     tevents.mark(f"local_sgd:{config.global_update}", emit_event=False)
+    _check_sync_sampler(config)
+    from tpu_distalg.parallel import ssp as _pssp
+
+    if _pssp.SyncSpec.parse(config.sync).is_ssp:
+        return _train_ssp(
+            X_train, y_train, X_test, y_test, mesh, config,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
     if config.sampler in ("fused_gather", "fused_train"):
         return _train_fused(
             X_train, y_train, X_test, y_test, mesh, config,

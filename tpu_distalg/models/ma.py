@@ -7,7 +7,12 @@ average combine, resync each round).
 Inherits the full comm treatment from :mod:`~tpu_distalg.models.local_sgd`:
 ``comm='int8'``/``'topk'``/... compresses the round-end average on the
 native wire, with the bucket-overlap pipeline on by default (``@seq``
-disables — bitwise-identical).
+disables — bitwise-identical). Likewise the sync discipline:
+``sync='ssp[:s]'`` runs the stale-synchronous harness — the average
+fires once per ``s``-round window, straggled replicas (seeded
+``shard:straggle`` plan rules) contribute stale models at
+staleness-decayed weight instead of stalling the mesh, and
+``shard:leave`` rules drive elastic membership epochs.
 """
 
 from __future__ import annotations

@@ -105,6 +105,20 @@ class SSGDConfig:
     # per-step collective exists to compress), 'fixed' and
     # feature_sharded reject non-dense comm.
     comm: str = "dense"
+    # synchronization discipline (parallel/ssp.py): 'bsp' (classic
+    # lock-step, one collective per step — bitwise the pre-SSP trainer,
+    # the default) or 'ssp[:s[:decay]]' (stale-synchronous: shards run
+    # up to s steps ahead of the slowest peer, the gradient merge runs
+    # once per s-tick window with staleness-weighted delayed-gradient
+    # application, and a device-resident clock vector — combined via
+    # the comms layer — gates only bound-violating shards, so a
+    # straggler no longer serializes every step). Seeded
+    # 'shard:straggle'/'shard:leave' fault-plan rules compile into the
+    # deterministic straggler/membership schedules; same plan => a
+    # bitwise-identical replay. SSP composes with the 'bernoulli'
+    # sampler (the XLA path) and any --comm schedule; the fused
+    # kernels and feature_sharded stay BSP.
+    sync: str = "bsp"
 
 
 @dataclasses.dataclass
@@ -128,6 +142,18 @@ def _comm_sync(mesh, config, d: int):
     example = (jax.ShapeDtypeStruct((d,), jnp.float32),
                jax.ShapeDtypeStruct((), jnp.float32))
     return comms.make_sync(config.comm, mesh, example)
+
+
+def _ssp_comm_sync(mesh, config, d: int):
+    """The SSP merge's CommSync: ONE (D,) leaf — the staleness-weighted
+    delta contribution (the clock vector rides a separate dense psum;
+    integer clocks must stay exact under every schedule)."""
+    import jax
+
+    from tpu_distalg.parallel import comms
+
+    return comms.make_sync(
+        config.comm, mesh, jax.ShapeDtypeStruct((d,), jnp.float32))
 
 
 def _build_scan_comm(config: SSGDConfig, sample_and_grad, prep_xs=None):
@@ -318,6 +344,395 @@ def _check_comm_sampler(config: SSGDConfig) -> None:
             "legacy gather path) — use 'bernoulli', 'fused' or "
             "'fused_gather'"
         )
+
+
+def _check_sync_sampler(config: SSGDConfig) -> None:
+    """Reject sync/sampler combinations up front, remedy named."""
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    if not spec.is_ssp:
+        return
+    if config.sampler != "bernoulli" or config.use_pallas \
+            or config.feature_sharded:
+        raise ValueError(
+            f"sync={config.sync!r} (stale-synchronous) composes with "
+            f"the 'bernoulli' sampler on a pure-dp mesh — got "
+            f"sampler={config.sampler!r} use_pallas={config.use_pallas} "
+            f"feature_sharded={config.feature_sharded}; the fused "
+            f"kernels and the tp split stay BSP")
+
+
+def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
+                      d: int, *, active: tuple[bool, ...],
+                      n_win_seg: int, total_ticks: int):
+    """The SSP window scan: one compiled fn per (active set, segment
+    window count), called per epoch segment by :func:`_train_ssp`.
+
+    Call as ``fn(X, y, valid, X_test, y_test, w0, clocks0, pend0,
+    basegen0, wl0, accd0, res0, extra_seg, win0)`` where ``extra_seg``
+    is the segment's ``(n_win_seg, s, S)`` straggle schedule slice and
+    ``win0`` the absolute window offset; returns ``(w, clocks, pend,
+    basegen, wl, accd, res, win_accs, ages_max, ages_mean, gated)``.
+
+    Per window: shards with no undelivered progress ADOPT the fresh
+    center (base generation = this window); each of the ``s`` ticks is
+    a LOCAL SGD step — no collective — skipped when the seeded straggle
+    schedule claims the tick or the clock gate trips (conservative SSP
+    gate: own clock minus the window-start active minimum ≥ the bound);
+    at the boundary, shards not straggling deliver their accumulated
+    update, weighted ``decay**age`` (age = windows since their base
+    model — delayed-gradient application), the clock vector is combined
+    through the comms layer, and the center moves by the weighted
+    average. A shard straggled AT the boundary keeps accumulating and
+    delivers later at a staler weight — nothing is ever waited for,
+    nothing is ever lost.
+    """
+    import numpy as np
+
+    from tpu_distalg.parallel import DATA_AXIS, comms
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    s = spec.staleness
+    sync = _ssp_comm_sync(mesh, config, d)
+    key = prng.root_key(config.seed)
+    active_np = np.asarray(active, bool)
+    big = jnp.int32(1 << 30)
+
+    def window_body(X, y, masks, w, clocks, pend, basegen, wl, accd,
+                    res, extra, tickv, winid):
+        from jax import lax
+
+        my = lax.axis_index(DATA_AXIS)
+        act = jnp.asarray(active_np)
+        act_me = act[my]
+        wl = wl[0]
+        accd = accd[0]
+        # shards with nothing pending adopt the fresh center: their
+        # base model is THIS window's merged state (age 0 at delivery)
+        adopt = act & jnp.logical_not(pend)
+        basegen = jnp.where(adopt, winid, basegen)
+        max_c = jnp.max(jnp.where(act, clocks, -big))
+        # an adopting shard holds the freshest model — its clock jumps
+        # to the head of the pack so a historical lag (a rejoiner's
+        # absence) cannot trip the gate against CURRENT staleness
+        clocks_adj = jnp.where(adopt, max_c, clocks)
+        min_known = jnp.min(jnp.where(act, clocks_adj, big))
+        wl = jnp.where(act_me & jnp.logical_not(pend[my]), w, wl)
+        accd = jnp.where(act_me & jnp.logical_not(pend[my]),
+                         jnp.zeros_like(accd), accd)
+
+        def tick(carry, xs):
+            w_l, acc, my_clock, gated_ct = carry
+            mask_l, extra_t, tv = xs
+            # pad ticks (tv False, past total_ticks) pay NO
+            # interference: the BSP A/B arm never runs them, so a
+            # straggle cell landing in the padding would bias the
+            # measured speedup against SSP
+            eu = jnp.where(tv, extra_t[my], 0)
+            gated = (my_clock - min_known) >= jnp.int32(s)
+            do = (tv & act_me & (eu == 0)
+                  & jnp.logical_not(gated))
+            # the compiled-in straggler: real FLOPs on this shard only,
+            # entangled below so the delay sits on the critical path
+            dummy = pssp.straggle_work(eu, 1.0)
+            g, cnt = logistic.grad_sum(X, y, w_l, mask_l)
+            reg = logistic.reg_gradient(
+                w_l, config.reg_type, config.elastic_alpha)
+            upd = config.eta * (g / jnp.maximum(cnt, 1.0)
+                                + config.lam * reg)
+            dof = do.astype(jnp.float32)
+            w_l = pssp.entangle(w_l - dof * upd, dummy)
+            acc = acc - dof * upd
+            my_clock = my_clock + do.astype(clocks.dtype)
+            gated_ct = gated_ct + (tv & act_me & gated).astype(
+                jnp.int32)
+            return (w_l, acc, my_clock, gated_ct), None
+
+        (wl, accd, my_clock, my_gated), _ = lax.scan(
+            tick, (wl, accd, clocks_adj[my], jnp.int32(0)),
+            (masks, extra, tickv))
+
+        # the clock vector, combined via the comms layer (ints ride the
+        # dense path of any schedule — a compressed count would corrupt
+        # the staleness math for no byte win)
+        clocks_new = comms.psum(
+            jnp.zeros_like(clocks).at[my].set(my_clock))
+        gated = comms.psum(my_gated)
+        stepped = clocks_new > clocks_adj
+        pend2 = (pend | stepped) & act
+        boundary_busy = extra[-1] > 0
+        deliver = pend2 & jnp.logical_not(boundary_busy) & act
+        ages = jnp.maximum(winid - basegen, 0)
+        wts = pssp.staleness_weights(ages, act, deliver, spec.decay)
+        wsum = jnp.sum(wts)
+        contrib = wts[my] * accd
+        (summed,), res_new = sync.reduce((contrib,), res, winid)
+        # a merge nobody delivered to is a NO-OP, not an epsilon
+        # division: the collective still ran (SPMD requires it), but a
+        # stateful schedule (topk) flushed its error-feedback residual
+        # into `summed` — applying that over the 1e-12 clamp would
+        # multiply it by 1e12, and keeping res_new would silently lose
+        # the flushed mass. Discard both: the residual rides to the
+        # next merge exactly as if the boundary never fired.
+        delivered_any = wsum > 0
+        w_new = w + jnp.where(
+            delivered_any,
+            summed / jnp.maximum(wsum, jnp.float32(1e-12)), 0.0)
+        res_new = jnp.where(delivered_any, res_new, res)
+        ages_obs = jnp.where(deliver, ages, 0)
+        n_del = jnp.sum(deliver.astype(jnp.float32))
+        ages_max = jnp.max(ages_obs).astype(jnp.float32)
+        ages_mean = (jnp.sum(ages_obs.astype(jnp.float32))
+                     / jnp.maximum(n_del, 1.0))
+        pend_out = pend2 & jnp.logical_not(deliver)
+        accd = jnp.where(deliver[my], jnp.zeros_like(accd), accd)
+        return (w_new, clocks_new, pend_out, basegen, wl[None],
+                accd[None], res_new, ages_max, ages_mean, gated)
+
+    window_fn = data_parallel(
+        window_body, mesh,
+        in_specs=(
+            P("data", None),    # X rows
+            P("data"),          # y
+            P(None, "data"),    # masks (s, rows)
+            P(),                # center w
+            P(), P(), P(),      # clocks, pend, basegen (replicated)
+            P("data", None),    # per-shard local models (S, D)
+            P("data", None),    # per-shard accumulated deltas (S, D)
+            P("data", None),    # error-feedback residual (S, E)
+            P(), P(), P(),      # extra (s, S), tick validity, winid
+        ),
+        out_specs=(P(), P(), P(), P(), P("data", None),
+                   P("data", None), P("data", None), P(), P(), P()),
+    )
+
+    def train(X, y, valid, X_test, y_test, w0, clocks0, pend0,
+              basegen0, wl0, accd0, res0, extra_seg, win0):
+        def win_step(carry, xs):
+            w, clocks, pend, basegen, wl, accd, res = carry
+            i, extra_w = xs
+            winid = (win0 + i).astype(jnp.int32)
+            ts = winid * s + jnp.arange(s)
+            masks = jax.vmap(
+                lambda t: sampling.bernoulli_mask(
+                    key, t, n_padded, config.mini_batch_fraction,
+                    valid))(ts)
+            tickv = ts < total_ticks
+            (w, clocks, pend, basegen, wl, accd, res, amax, amean,
+             gated) = window_fn(X, y, masks, w, clocks, pend, basegen,
+                                wl, accd, res, extra_w, tickv, winid)
+            acc = (metrics.binary_accuracy(X_test @ w, y_test)
+                   if config.eval_test else jnp.float32(0))
+            return ((w, clocks, pend, basegen, wl, accd, res),
+                    (acc, amax, amean, gated))
+
+        carry0 = (w0, clocks0, pend0, basegen0, wl0, accd0, res0)
+        carry, (accs, amax, amean, gated) = jax.lax.scan(
+            win_step, carry0, (jnp.arange(n_win_seg), extra_seg))
+        return (*carry, accs, amax, amean, gated)
+
+    return jax.jit(train)
+
+
+def ssp_init_state(mesh: Mesh, config: SSGDConfig, d: int, *,
+                   w=None, clocks=None, win0: int = 0):
+    """Host-side SSP carry for :func:`make_ssp_train_fn`, in call
+    order: ``(w, clocks, pending, base_gen, local_models,
+    accumulated_deltas, ef_residual)``. The ONE place the state layout
+    lives — the training driver's step-0 state, its cross-geometry
+    renegotiation AND the bench's timing arm all build here, so a
+    carry change can never leave a hand-rolled copy behind."""
+    import numpy as np
+
+    from tpu_distalg.parallel import DATA_AXIS
+
+    n_shards = int(mesh.shape[DATA_AXIS])
+    sync = _ssp_comm_sync(mesh, config, d)
+    w = (np.zeros((d,), np.float32) if w is None
+         else np.asarray(w, np.float32))
+    clocks = (np.zeros((n_shards,), np.int32) if clocks is None
+              else np.asarray(clocks, np.int32))
+    return (w, clocks,
+            np.zeros((n_shards,), bool),                 # pending
+            np.full((n_shards,), int(win0), np.int32),   # base gen
+            np.tile(w, (n_shards, 1)),                   # local models
+            np.zeros((n_shards, d), np.float32),         # accumulated Δ
+            np.asarray(sync.init_state()))               # EF residual
+
+
+def make_bsp_straggler_fn(mesh: Mesh, config: SSGDConfig,
+                          n_padded: int, extra):
+    """The speedup bench's BSP arm: the classic per-step
+    (Σ grad, count) psum trainer — same sampling and update math as
+    :func:`make_train_fn`'s default path, so the trajectory is BITWISE
+    the plain BSP one — with the compiled straggle schedule's
+    interference compute entangled on each shard's gradient BEFORE the
+    collective. The per-tick psum is a barrier, so every shard's delay
+    is paid serially by the whole mesh: exactly the cost the SSP
+    window structure removes, measured instead of claimed.
+    ``extra`` is the (n_ticks, n_shards) schedule from
+    :func:`ssp.compile_straggle_schedule`. Returns
+    ``fn(X, y, valid, X_test, y_test, w0)`` → ``(w, accs)``."""
+    from jax import lax
+
+    from tpu_distalg.parallel import DATA_AXIS
+    from tpu_distalg.parallel import ssp as pssp
+
+    key = prng.root_key(config.seed)
+    extra_arr = jnp.asarray(extra, jnp.int32)
+
+    def _local_grad(X, y, mask, w, extra_t):
+        my = lax.axis_index(DATA_AXIS)
+        dummy = pssp.straggle_work(extra_t[my], 1.0)
+        g, cnt = logistic.grad_sum(X, y, w, mask)
+        # the entangle puts the interference on the collective's
+        # critical path; values are untouched (identity), so BSP under
+        # a straggle plan stays bitwise BSP — only slower
+        g = pssp.entangle(g, dummy)
+        return tree_allreduce_sum((g, cnt))
+
+    grad_fn = data_parallel(
+        _local_grad, mesh,
+        in_specs=(P("data", None), P("data"), P("data"), P(), P()),
+        out_specs=(P(), P()),
+    )
+
+    def prep_xs(ts):
+        return jnp.take(extra_arr, ts, axis=0)
+
+    def sample_and_grad(X, y, valid, w, payload):
+        t, extra_t = payload
+        mask = sampling.bernoulli_mask(
+            key, t, n_padded, config.mini_batch_fraction, valid)
+        return grad_fn(X, y, mask, w, extra_t)
+
+    return _build_scan(config, sample_and_grad,
+                       prep_xs=lambda ts: (ts, prep_xs(ts)))
+
+
+def window_accs_to_ticks(win_accs, s: int, n_ticks: int):
+    """Expand per-window accuracies to the per-tick history every other
+    trainer reports: tick t carries the last merge's accuracy (0 before
+    the first merge), the final tick the final merge's — the
+    ``fused_train`` eval-at-boundary idiom, window-shaped. Pure, so
+    segmented and straight runs assemble identical histories."""
+    import numpy as np
+
+    win_accs = np.asarray(win_accs, np.float32)
+    if win_accs.size == 0 or n_ticks <= 0:
+        # degenerate runs (n_iterations=0 still executes one fully
+        # masked window) report an empty history like the BSP paths
+        return np.zeros((max(0, n_ticks),), np.float32)
+    prev = np.concatenate([[np.float32(0.0)], win_accs[:-1]])
+    accs = np.repeat(prev, s)
+    accs[s - 1::s] = win_accs
+    accs = accs[:n_ticks]
+    accs[-1] = win_accs[-1]
+    return accs
+
+
+def _train_ssp(
+    X_train, y_train, X_test, y_test, mesh: Mesh, config: SSGDConfig,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
+) -> TrainResult:
+    """Stale-synchronous training driver (``sync='ssp[:s[:decay]]'``):
+    windows of ``s`` ticks between merges, seeded straggle/membership
+    schedules compiled from the active fault plan, elastic epochs via
+    :func:`membership.run_elastic` (checkpointed at window granularity;
+    a resume on a different shard count renegotiates the ring instead
+    of rejecting). The trajectory is a pure function of (config, data,
+    plan), so a replay under the same plan is bitwise-identical."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    from tpu_distalg.parallel import DATA_AXIS, comms, membership
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(config.sync)
+    s = spec.staleness
+    T = config.n_iterations
+    d = X_train.shape[1]
+    n_shards = int(mesh.shape[DATA_AXIS])
+    Xs = parallelize(X_train, mesh, dtype=jnp.dtype(config.x_dtype))
+    ys = parallelize(y_train, mesh)
+    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+    w0 = np.asarray(logistic.init_weights(
+        prng.root_key(config.init_seed), d), np.float32)
+    n_win, padded_ticks = pssp.window_grid(T, s)
+    extra = pssp.compile_straggle_schedule(padded_ticks, n_shards)
+    extra[T:] = 0  # pad ticks don't exist: no interference, no busy
+    extra = extra.reshape(n_win, s, n_shards)
+    sync = _ssp_comm_sync(mesh, config, d)
+    shard2 = NamedSharding(mesh, P("data", None))
+
+    def fresh_state(w_host, clocks, win0: int):
+        """Full state from the replicated center — both the step-0
+        state and the cross-geometry redistribution (every epoch
+        boundary is a resync point, so per-shard state is DERIVED, not
+        resharded). Layout lives in :func:`ssp_init_state`."""
+        return ssp_init_state(mesh, config, d, w=w_host,
+                              clocks=clocks, win0=win0)
+
+    def renegotiate(saved_leaves, saved_shards, start_win):
+        del saved_shards
+        return fresh_state(
+            saved_leaves[0],
+            membership.redistribute_clocks(saved_leaves[1], n_shards),
+            start_win)
+
+    def make_seg_fn(active, n_win_seg):
+        return make_ssp_train_fn(
+            mesh, config, Xs.n_padded, d, active=active,
+            n_win_seg=n_win_seg, total_ticks=T)
+
+    def run_seg(fn, state, win0, n_win_seg, epoch):
+        del epoch
+        w, clocks, pend, basegen, wl, accd, res = state
+        wl = jax.device_put(jnp.asarray(np.asarray(wl)), shard2)
+        accd = jax.device_put(jnp.asarray(np.asarray(accd)), shard2)
+        res = jax.device_put(jnp.asarray(np.asarray(res)), shard2)
+        out = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
+                 jnp.asarray(np.asarray(w, np.float32)),
+                 jnp.asarray(np.asarray(clocks, np.int32)),
+                 jnp.asarray(np.asarray(pend, bool)),
+                 jnp.asarray(np.asarray(basegen, np.int32)),
+                 wl, accd, res,
+                 jnp.asarray(extra[win0:win0 + n_win_seg]),
+                 jnp.int32(win0))
+        state = out[:7]
+        accs, amax, amean, gated = out[7:]
+        return state, (accs, amax, amean, gated)
+
+    state, outs, start, epochs = membership.run_elastic(
+        checkpoint_dir, max(1, checkpoint_every // s), n_win, n_shards,
+        make_seg_fn=make_seg_fn, run_seg=run_seg,
+        state0=fresh_state(w0, np.zeros(n_shards, np.int32), 0),
+        renegotiate=renegotiate,
+        # the sync spec is part of the tag: windows are indexed in
+        # s-tick units and merge weights depend on decay, so a resume
+        # under a DIFFERENT bound would silently reinterpret the saved
+        # progress — it must reject like any other workload mismatch
+        tag=f"ssgd:{spec.spec()}:comm={config.comm}",
+        ticks_per_window=s)
+
+    w = jnp.asarray(np.asarray(state[0], np.float32))
+    metrics.guard_finite(w, "SSGD (ssp) weights")
+    accs = window_accs_to_ticks(outs[0], s, T) if outs \
+        else np.zeros((T,), np.float32)
+    stats = pssp.observed_staleness(
+        outs[1] if outs else [], outs[2] if outs else [])
+    pssp.emit_ssp_counters(
+        spec, stats,
+        straggle_ticks=int(np.count_nonzero(extra)),
+        gated_ticks=int(np.asarray(outs[3]).sum()) if outs else 0,
+        epochs=len(epochs))
+    comms.emit_sync_counters(sync, n_win - start)
+    return TrainResult(w=w, accs=jnp.asarray(accs))
 
 
 def _make_train_fn_comm(mesh: Mesh, config: SSGDConfig, n_padded: int,
@@ -938,6 +1353,14 @@ def train(
     # inside run_segmented)
     tevents.mark(f"ssgd:{config.sampler}", emit_event=False)
     _check_comm_sampler(config)
+    _check_sync_sampler(config)
+    from tpu_distalg.parallel import ssp as _pssp
+
+    if _pssp.SyncSpec.parse(config.sync).is_ssp:
+        return _train_ssp(
+            X_train, y_train, X_test, y_test, mesh, config,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
     if config.sampler in ("fused", "fused_gather", "fused_train"):
         if config.feature_sharded:
             if config.sampler != "fused_gather":

@@ -36,6 +36,8 @@ from tpu_distalg.parallel.comms import (
     CommSync,
     make_sync,
 )
+from tpu_distalg.parallel import membership, ssp
+from tpu_distalg.parallel.ssp import SyncSpec
 from tpu_distalg.parallel.spmd import data_parallel, replica_index
 from tpu_distalg.parallel.ring import (
     alltoall_head_to_seq,
@@ -55,7 +57,10 @@ __all__ = [
     "MODEL_AXIS",
     "MeshContext",
     "ShardedMatrix",
+    "SyncSpec",
     "make_sync",
+    "membership",
+    "ssp",
     "all_gather",
     "all_to_all",
     "alltoall_head_to_seq",

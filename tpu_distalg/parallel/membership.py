@@ -1,0 +1,337 @@
+"""Elastic shard membership — generation-numbered epochs.
+
+The second half of the stale-synchronous layer (``parallel/ssp.py``):
+the PARTICIPANT SET may change while training runs. A shard leaves at a
+window boundary (in production: the ``Preempted`` rc-75 exit of PR 3's
+machinery — the subprocess test drives exactly that path) and rejoins
+later; the comms layer renegotiates at each membership change — a new
+GENERATION gets a freshly derived ring/bucket geometry (the merge
+``CommSync`` and clock combine are rebuilt for the epoch's active set)
+and the sharded optimizer state is redistributed at the boundary. The
+portable-redistribution blueprint (arXiv:2112.01075) is followed where
+it is cheap and honest for this state family: every epoch boundary
+coincides with a merge, where per-replica models resync from the
+replicated center and error-feedback residuals have just been flushed
+into the contribution — so redistribution is re-DERIVATION from the
+replicated state at the new geometry, never a resharding of torn
+per-device buffers.
+
+Two complementary mechanisms, both deterministic:
+
+  * IN-PROCESS epochs: ``compile_epochs`` turns the seeded fault
+    plan's ``shard:leave`` rules into a generation-numbered epoch list
+    (one ``faults.probe`` per (boundary, shard) cell, fixed order — a
+    pure function of the plan, replayed bitwise). Departed shards'
+    devices keep executing the SPMD program (a collective cannot run
+    without them) but are masked: zero merge weight, no local steps —
+    on an emulated single-host mesh that is the honest statement of
+    what "left" means.
+  * CROSS-PROCESS elasticity: a checkpointed SSP run resumed with a
+    DIFFERENT ``--n-slices`` renegotiates instead of rejecting — the
+    persisted state is shard-count-agnostic (replicated center + step
+    clocks), the generation bumps, per-shard state is re-derived at
+    the new geometry, and the run completes. Leaving = the rc-75
+    preemption exit; rejoining = re-running with the shard back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_distalg.faults import registry as fregistry
+
+
+@dataclasses.dataclass(frozen=True)
+class Epoch:
+    """One membership generation: windows [start, end) run with the
+    fixed ``active`` shard set."""
+
+    gen: int
+    start: int                 # first window index (inclusive)
+    end: int                   # last window index (exclusive)
+    active: tuple[bool, ...]   # per logical shard
+
+    @property
+    def n_active(self) -> int:
+        return sum(self.active)
+
+
+def compile_epochs(n_windows: int, n_shards: int, *,
+                   plan=None) -> list[Epoch]:
+    """Membership epochs from the fault plan's ``shard:leave`` rules:
+    one probe per (window boundary, shard) in row-major order against
+    a FRESH registry built from the plan (a pure function of the plan,
+    like the straggle schedule — restarts and resumes recompile the
+    identical epochs; fires are mirrored into the live ledger); a
+    fired ``leave:r`` rule marks the shard absent for the next
+    ``ceil(r)`` windows (default ``DEFAULT_LEAVE_WINDOWS``), rejoining
+    after. Overlapping absences extend. A leave that would empty the
+    active set is ignored — the mesh never goes quorumless — and the
+    generation number increments at every membership CHANGE, so epoch
+    boundaries are exactly the ring renegotiations."""
+    live = fregistry.active()
+    if plan is None:
+        plan = live.plan if live is not None else None
+    absent_until = np.zeros(n_shards, np.int64)
+    has_rules = plan is not None and any(
+        r.point == "shard:leave" for r in plan.rules)
+    # quiet: fires reach telemetry once, via live.record() at the end
+    reg = (fregistry.FaultRegistry(plan, quiet=True)
+           if has_rules else None)
+    epochs: list[Epoch] = []
+    gen = 1
+    cur: tuple[bool, ...] | None = None
+    for b in range(n_windows):
+        if has_rules:
+            for k in range(n_shards):
+                hit = reg.probe("shard:leave")
+                if hit is None:
+                    continue
+                _, arg = hit
+                away = int(np.ceil(arg if arg is not None
+                                   else fregistry.DEFAULT_LEAVE_WINDOWS))
+                absent_until[k] = max(absent_until[k], b + max(1, away))
+        active = tuple(bool(absent_until[k] <= b)
+                       for k in range(n_shards))
+        if not any(active):
+            # never quorumless: the longest-absent shard is retained
+            keep = int(np.argmin(absent_until))
+            active = tuple(k == keep for k in range(n_shards))
+        if active != cur:
+            if epochs:
+                epochs[-1] = dataclasses.replace(epochs[-1], end=b)
+            if cur is not None:
+                gen += 1
+            epochs.append(Epoch(gen=gen, start=b, end=n_windows,
+                                active=active))
+            cur = active
+    if not epochs:
+        epochs.append(Epoch(gen=1, start=0, end=n_windows,
+                            active=(True,) * n_shards))
+    if reg is not None and live is not None and live.plan == plan:
+        live.record(reg.fired)
+    return epochs
+
+
+def emit_epoch_event(epoch: Epoch, *, reason: str,
+                     prev_active: int | None = None) -> None:
+    """Record a ring renegotiation: a ``membership_epoch`` event plus
+    the ``ssp.membership_epochs`` counter feed ``tda report``'s SSP
+    line. No-op when telemetry is disabled."""
+    from tpu_distalg.telemetry import events as tevents
+
+    tevents.emit("membership_epoch", gen=epoch.gen,
+                 n_active=epoch.n_active,
+                 prev_active=prev_active, reason=reason,
+                 active=[int(a) for a in epoch.active])
+
+
+def redistribute_clocks(clocks, n_new: int):
+    """Clock vector for a renegotiated geometry: a cross-process
+    membership change is a FULL resync boundary (the checkpointed
+    center is the state everyone restarts from), so every member of
+    the new generation resumes at the maximum clock — ages start at
+    zero against the freshest model, which is exactly what a rejoining
+    shard holds after redistribution."""
+    c = np.asarray(clocks)
+    top = int(c.max()) if c.size else 0
+    return np.full((n_new,), top, np.int64)
+
+
+def describe_renegotiation(gen: int, n_old: int, n_new: int) -> str:
+    return (f"[ssp] ring renegotiated: {n_old} -> {n_new} shard(s), "
+            f"membership generation {gen} (geometry re-derived; "
+            f"sharded state re-derived from the replicated center)")
+
+
+def run_elastic(
+    checkpoint_dir: str | None,
+    checkpoint_every: int,
+    n_windows: int,
+    n_shards: int,
+    *,
+    make_seg_fn,
+    run_seg,
+    state0,
+    renegotiate=None,
+    on_epoch=None,
+    tag: str = "",
+    ticks_per_window: int = 1,
+    keep: int = 3,
+    logger=None,
+):
+    """The elastic windowed training loop — ``run_segmented``'s shape
+    at WINDOW granularity with membership epochs layered in.
+
+    Epochs come from :func:`compile_epochs` (the active plan's seeded
+    ``shard:leave`` rules); each segment runs with ONE fixed active set
+    and one compiled fn (``make_seg_fn(active, n_win)``, cached), and
+    segment boundaries are the union of epoch boundaries and
+    ``checkpoint_every``-window checkpoints. ``run_seg(fn, state, win0,
+    n_win, epoch)`` executes a segment and returns ``(state, outs)``
+    where ``outs`` is a tuple of per-window host arrays (accuracy and
+    staleness traces), concatenated across segments by the driver.
+
+    In-process membership changes need NO state surgery: the SSP
+    program re-derives a rejoining shard's local state from the
+    replicated center at its adopt step (and a departing shard's
+    pending delta is parked exactly like a preempted worker's would
+    be); the driver's job at an epoch boundary is the renegotiation
+    record and the fresh compiled geometry.
+
+    Cross-process elasticity rides the checkpoint: the payload records
+    the writing geometry's shard count, and a resume on a DIFFERENT
+    shard count calls ``renegotiate(saved_leaves, saved_shards,
+    start_window)`` — the trainer re-derives per-shard state from the
+    replicated center — instead of rejecting. Preemption exits at the
+    next segment boundary AFTER the durable save with the distinct
+    rc 75 (never burning restart budget), which is precisely the
+    "leave at a ``Preempted`` boundary" contract: the departed
+    process's shards rejoin when the command re-runs.
+
+    Returns ``(state, outs_concat, start_window, epochs)``.
+    """
+    import jax
+
+    from tpu_distalg import faults
+    from tpu_distalg.telemetry import events as tevents
+    from tpu_distalg.utils import checkpoint as ckpt
+    from tpu_distalg.utils import metrics
+
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    if logger is None:
+        import functools
+        import sys
+
+        logger = functools.partial(print, file=sys.stderr)
+    log = logger
+    epochs = compile_epochs(n_windows, n_shards)
+    leaves0, treedef = jax.tree.flatten(state0)
+    state = state0
+    start = 0
+    outs_parts: list[tuple[np.ndarray, ...]] = []
+
+    if checkpoint_dir:
+        restored = ckpt.restore_newest_with_fallback(checkpoint_dir,
+                                                     logger=logger)
+    else:
+        restored = None
+    if restored is not None:
+        payload, start = restored
+        saved_tag = ckpt.decode_tag(payload, tag)
+        # the tag check comes FIRST: a foreign checkpoint's step count
+        # is in that workload's units (ticks vs windows), so any other
+        # diagnosis about it would mislead
+        if "state" not in payload or saved_tag != tag:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} holds workload "
+                f"{saved_tag!r}, this run is {tag!r} — written by a "
+                f"different workload or framework version; use a "
+                f"fresh directory")
+        if start > n_windows:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} is at window {start}, "
+                f"past n_windows={n_windows}; use a fresh directory")
+        saved_shards = int(np.asarray(payload.get("shards", n_shards)))
+        saved_leaves = [np.asarray(v) for v in payload["state"]]
+        if saved_shards != n_shards:
+            if renegotiate is None:
+                raise ValueError(
+                    f"checkpoint in {checkpoint_dir} was written at "
+                    f"{saved_shards} shard(s), this mesh has "
+                    f"{n_shards} and the workload does not support "
+                    f"elastic renegotiation")
+            cur = next((e for e in epochs if e.start <= start < e.end),
+                       epochs[-1])
+            state = renegotiate(saved_leaves, saved_shards, start)
+            emit_epoch_event(cur, reason="renegotiated_resume",
+                             prev_active=saved_shards)
+            tevents.counter("ssp.membership_epochs")
+            log(describe_renegotiation(cur.gen, saved_shards, n_shards))
+        else:
+            sig = [(tuple(v.shape), str(v.dtype)) for v in saved_leaves]
+            want = [(tuple(np.asarray(x).shape),
+                     str(np.asarray(x).dtype)) for x in leaves0]
+            if sig != want:
+                raise ValueError(
+                    f"checkpoint in {checkpoint_dir} state {sig} does "
+                    f"not match this run's {want} — different config "
+                    f"or framework version; use a fresh directory")
+            state = jax.tree.unflatten(treedef, saved_leaves)
+        outs_parts = [tuple(np.asarray(v)
+                            for v in payload.get("outs", []))]
+
+    seg_fns: dict = {}
+    win = start
+    # seed prev_epoch from the window BEFORE the resume point: a
+    # preempt exit lands exactly on segment boundaries, which include
+    # every epoch boundary — without this, a resume landing on a
+    # membership transition would skip the on_epoch fixup (the EASGD
+    # rejoiner clock bump) and recreate the frozen-clock gate stall
+    prev_epoch: Epoch | None = None
+    if start > 0:
+        prev_epoch = next((e for e in epochs
+                           if e.start <= start - 1 < e.end), None)
+    while win < n_windows:
+        epoch = next(e for e in epochs if e.start <= win < e.end)
+        if prev_epoch is not None and epoch.gen != prev_epoch.gen:
+            emit_epoch_event(epoch, reason="membership_change",
+                             prev_active=prev_epoch.n_active)
+            tevents.counter("ssp.membership_epochs")
+            log(f"[ssp] membership epoch {epoch.gen}: "
+                f"{epoch.n_active}/{n_shards} shard(s) active")
+            if on_epoch is not None:
+                # trainer hook for membership-transition state fixups
+                # the compiled program cannot express (e.g. EASGD never
+                # resyncs, so a rejoiner's frozen clock must be bumped
+                # HERE or the gate would serialize the mesh onto it)
+                state = on_epoch(state, prev_epoch, epoch)
+        prev_epoch = epoch
+        seg_end = min(epoch.end,
+                      ((win // checkpoint_every) + 1) * checkpoint_every,
+                      n_windows)
+        n_win = seg_end - win
+        tevents.mark(f"ssp:{tag or 'train'}@w{win}", emit_event=False)
+        faults.inject("segment:run")
+        key = (epoch.active, n_win)
+        if key not in seg_fns:
+            seg_fns[key] = make_seg_fn(epoch.active, n_win)
+        state, outs = run_seg(seg_fns[key], state, win, n_win, epoch)
+        metrics.guard_finite(state, f"SSP state after window {seg_end}")
+        outs_parts.append(tuple(np.asarray(o) for o in outs))
+        win = seg_end
+        if checkpoint_dir:
+            streams = _cat_streams(outs_parts)
+            ckpt.save(
+                checkpoint_dir,
+                {"tag": ckpt.encode_tag(tag),
+                 "shards": np.int64(n_shards),
+                 "state": [np.asarray(x)
+                           for x in jax.tree.leaves(state)],
+                 "outs": streams},
+                step=win)
+            ckpt.prune(checkpoint_dir, keep=keep)
+            tevents.emit("checkpoint_saved",
+                         step=win * ticks_per_window, tag=tag)
+            tevents.counter("checkpoints_saved")
+            if win < n_windows:
+                # shared boundary-exit contract (no-op when no request
+                # is pending) — the "leave at a Preempted boundary"
+                # path itself
+                ckpt.preempt_boundary_exit(win * ticks_per_window, tag)
+    return state, _cat_streams(outs_parts), start, epochs
+
+
+def _cat_streams(parts) -> list[np.ndarray]:
+    """Concatenate per-segment output tuples stream-wise, skipping
+    empty tuples (a resumed run whose checkpoint predates any
+    segment's outputs)."""
+    parts = [p for p in parts if p]
+    if not parts:
+        return []
+    return [np.concatenate([p[i] for p in parts])
+            for i in range(len(parts[0]))]

@@ -1,0 +1,259 @@
+"""Stale-synchronous coordination — bounded staleness for the SGD family.
+
+Every distributed-SGD trainer in this repo is bulk-synchronous (BSP):
+one collective per step/round means one slow, preempted or rejoining
+shard stalls the entire mesh, so wall-clock throughput is gated by the
+WORST participant. This module is the bounded-staleness alternative the
+ROADMAP's item 2 calls for: shards advance up to ``s`` ticks ahead of
+the slowest peer, the cross-shard merge runs once per ``s``-tick
+window instead of every tick, and a device-resident CLOCK VECTOR —
+combined through the existing comms layer, so any ``--comm`` schedule
+carries it — gates only the shards that exceed the bound. A straggler
+no longer serializes every step: its delay overlaps the window's other
+work, and its late contribution merges with STALENESS-WEIGHTED
+averaging (weight ``decay^age``) instead of being waited for. The
+MapReduce-over-a-clients-axis shape follows DrJAX (arXiv:2403.07128):
+local-update work runs ``map``-style over the data axis with one
+``reduce`` per window, which is exactly what lets the participant set
+vary (``parallel/membership.py``).
+
+Determinism contract (the property everything else in this repo rests
+on): straggler and membership schedules are compiled HOST-SIDE from the
+seeded fault plan (``shard:straggle`` / ``shard:leave`` rules,
+``faults/registry.py``) by one :func:`faults.probe` call per
+(tick, shard) cell in fixed row-major order — the schedule is a pure
+function of the plan, the injected interference is deterministic
+compute inside the program, and an SSP run replayed with the same plan
+is bitwise-identical. ``--sync bsp`` does not touch this module's
+program at all: the BSP trainers keep their pre-SSP XLA programs, so
+the golden-hash pins hold by construction.
+
+Why the speedup is real and not an accounting trick: under BSP the
+per-tick collective is a barrier, so tick time is
+``max_k(base + delay_k)`` and every shard's delay is paid serially by
+the whole mesh. Under SSP the window's ``s`` ticks have NO cross-shard
+data dependence — each device runs its own instruction stream until the
+merge rendezvous — so delays on different shards overlap and the window
+costs ``max_k Σ_t(base + delay_k(t))``. The bench's
+``ssgd_ssp_straggler_speedup`` measures exactly that: full step time,
+BSP vs SSP, under the same seeded straggler plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_distalg.faults import registry as fregistry
+
+#: default staleness bound (ticks a shard may run ahead of the slowest)
+DEFAULT_STALENESS = 4
+#: default per-age decay of a late contribution's merge weight
+DEFAULT_DECAY = 0.5
+#: one straggle "unit" = one pass of the interference kernel over a
+#: (STRAGGLE_LANES,) f32 vector — real FLOPs, deterministic values
+STRAGGLE_LANES = 4096
+
+SYNC_MODES = ("bsp", "ssp")
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncSpec:
+    """One run's synchronization discipline + knobs.
+
+    ``parse`` accepts the CLI spelling: ``bsp`` (classic lock-step —
+    the default, bitwise the pre-SSP trainers), ``ssp`` (bounded
+    staleness at the default bound), ``ssp:8`` (bound 8 ticks),
+    ``ssp:8:0.7`` (bound 8, staleness-weight decay 0.7).
+    """
+
+    mode: str = "bsp"
+    staleness: int = DEFAULT_STALENESS  # ticks per merge window / bound
+    decay: float = DEFAULT_DECAY        # weight = decay ** age
+
+    def __post_init__(self):
+        if self.mode not in SYNC_MODES:
+            raise ValueError(
+                f"unknown sync mode {self.mode!r}; want one of "
+                f"{', '.join(SYNC_MODES)} (spellings: 'bsp', 'ssp', "
+                f"'ssp:s', 'ssp:s:decay')")
+        if self.staleness < 1:
+            raise ValueError(
+                f"ssp staleness bound must be >= 1, got {self.staleness}")
+        if not (0.0 < self.decay <= 1.0):
+            raise ValueError(
+                f"ssp decay must be in (0, 1], got {self.decay}")
+
+    @classmethod
+    def parse(cls, text: str | "SyncSpec" | None) -> "SyncSpec":
+        if isinstance(text, cls):
+            return text
+        if not text:
+            return cls()
+        parts = str(text).split(":")
+        kw = {}
+        if parts[0] != "ssp" and len(parts) > 1:
+            # 'bsp:8' is almost certainly a typo of 'ssp:8' — silently
+            # dropping the bound would train lock-step BSP against the
+            # user's intent
+            raise ValueError(
+                f"bad --sync spelling {text!r}: only 'ssp' takes "
+                f"arguments ('ssp:s', 'ssp:s:decay')")
+        if len(parts) >= 2 and parts[1]:
+            kw["staleness"] = int(parts[1])
+        if len(parts) >= 3 and parts[2]:
+            kw["decay"] = float(parts[2])
+        if len(parts) > 3:
+            raise ValueError(
+                f"bad --sync spelling {text!r}: want 'bsp', 'ssp', "
+                f"'ssp:s' or 'ssp:s:decay'")
+        return cls(mode=parts[0], **kw)
+
+    @property
+    def is_ssp(self) -> bool:
+        return self.mode == "ssp"
+
+    def spec(self) -> str:
+        if self.mode == "bsp":
+            return "bsp"
+        return f"ssp:{self.staleness}:{self.decay:g}"
+
+
+def window_grid(n_ticks: int, staleness: int) -> tuple[int, int]:
+    """(n_windows, padded_ticks): ticks are grouped into full
+    ``staleness``-length windows; trailing pad ticks are masked no-ops
+    (valid=False), so any ``n_iterations`` works."""
+    n_win = max(1, -(-n_ticks // staleness))
+    return n_win, n_win * staleness
+
+
+def compile_straggle_schedule(n_ticks: int, n_shards: int, *,
+                              plan=None) -> np.ndarray:
+    """The (n_ticks, n_shards) int32 interference schedule, compiled
+    from the fault plan's ``shard:straggle`` rules: cell (t, k) holds
+    the straggle work units shard k pays at tick t (0 = none). One
+    probe per cell in row-major order against a FRESH registry built
+    from the plan — the schedule is a pure function of the plan (not
+    of how many probes earlier compilations consumed), so a restarted
+    or resumed run recompiles the identical schedule, which is what
+    the bitwise-replay acceptance rests on. Fires are mirrored into
+    the live registry's ledger so chaos verdicts and ``tda report``
+    still see them. An empty/absent plan compiles an all-zero
+    schedule."""
+    live = fregistry.active()
+    if plan is None:
+        plan = live.plan if live is not None else None
+    out = np.zeros((n_ticks, n_shards), np.int32)
+    if plan is None or not any(
+            r.point == "shard:straggle" for r in plan.rules):
+        return out
+    # quiet: fires reach telemetry exactly once via live.record()
+    # below, so a restart's recompilation cannot duplicate them
+    reg = fregistry.FaultRegistry(plan, quiet=True)
+    for t in range(n_ticks):
+        for k in range(n_shards):
+            hit = reg.probe("shard:straggle")
+            if hit is not None:
+                _, arg = hit
+                out[t, k] = int(arg if arg is not None
+                                else fregistry.DEFAULT_STRAGGLE_UNITS)
+    if live is not None and live.plan == plan:
+        live.record(reg.fired)
+    return out
+
+
+def straggle_work(units, salt):
+    """``units`` passes of a deterministic interference kernel over a
+    (STRAGGLE_LANES,) f32 vector — the compiled-in straggler. ``units``
+    may be a traced per-shard scalar (``lax.fori_loop`` takes a dynamic
+    bound), so only the straggling shard pays; entangle the returned
+    scalar with live state via ``lax.optimization_barrier`` so XLA
+    cannot dead-code-eliminate the delay (the values are untouched —
+    the barrier is an identity)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    v0 = jnp.full((STRAGGLE_LANES,), jnp.float32(salt))
+
+    def one(i, v):
+        del i
+        return v * jnp.float32(1.0000001) + jnp.float32(1e-7)
+
+    # the raw sum feeds an optimization_barrier operand (entangle), so
+    # the loop cannot be folded away; the value itself is never mixed
+    # into any carried state
+    return jnp.sum(lax.fori_loop(0, units, one, v0))
+
+
+def entangle(state, dummy):
+    """Tie ``dummy``'s computation into ``state``'s dependency chain
+    without changing any value: the straggle work must be on the
+    critical path of the carried state or the scheduler would hoist or
+    drop it, and the measured delay with it."""
+    from jax import lax
+
+    out, _ = lax.optimization_barrier((state, dummy))
+    return out
+
+
+def staleness_weights(ages, active, took, decay: float):
+    """Merge weights for one window: ``decay**age`` for the active
+    shards that have a contribution, 0 for everyone else. ``ages`` is
+    the per-shard contribution age in windows (0 = computed against the
+    freshest merged model), replicated; the caller normalizes by the
+    weight sum so the merge is a weighted average."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(decay, jnp.float32) ** ages.astype(jnp.float32)
+    return jnp.where(active & took, w, 0.0)
+
+
+def observed_staleness(ages_max, ages_mean) -> dict:
+    """Host-side summary of the per-window age traces the SSP scan
+    returns: the numbers the telemetry counters and ``tda report``'s
+    SSP line carry."""
+    am = np.asarray(ages_max)
+    return {
+        "max_staleness": int(am.max()) if am.size else 0,
+        "mean_staleness": (float(np.asarray(ages_mean).mean())
+                           if am.size else 0.0),
+        "merges": int(am.size),
+    }
+
+
+def emit_ssp_counters(spec: SyncSpec, stats: dict, *,
+                      straggle_ticks: int = 0, gated_ticks: int = 0,
+                      epochs: int = 1) -> None:
+    """Bump the ``ssp.*`` telemetry counters/gauges ``tda report``
+    renders (a no-op when telemetry is disabled): merge count, observed
+    max staleness, straggle/gated tick counts, membership epoch count,
+    and the mean observed staleness as a gauge."""
+    from tpu_distalg.telemetry import events as tevents
+
+    # counts accumulate across a session's runs (totals are
+    # meaningful); per-run EXTREMA and distribution stats ride gauges
+    # (last run wins) — a counter-summed "max" across the chaos
+    # harness's three trainings would misstate the observed bound
+    tevents.counter("ssp.merges", stats.get("merges", 0))
+    tevents.counter("ssp.straggle_ticks", straggle_ticks)
+    tevents.counter("ssp.gated_ticks", gated_ticks)
+    tevents.counter("ssp.membership_epochs", epochs)
+    tevents.gauge("ssp.max_staleness", stats.get("max_staleness", 0))
+    tevents.gauge("ssp.mean_staleness",
+                  round(stats.get("mean_staleness", 0.0), 4))
+    tevents.gauge("ssp.bound", spec.staleness)
+
+
+def emit_stall_avoided(bsp_seconds: float, ssp_seconds: float,
+                       n_ticks: int) -> float:
+    """Record the measured stall time SSP avoided vs BSP over the same
+    tick schedule (the bench's A/B is the honest observable — in-program
+    estimates would be accounting, not measurement). Returns the ms
+    figure fed to the ``ssp.stall_ms_avoided`` counter."""
+    from tpu_distalg.telemetry import events as tevents
+
+    ms = max(0.0, (bsp_seconds - ssp_seconds) * 1e3)
+    tevents.counter("ssp.stall_ms_avoided", int(round(ms)))
+    tevents.counter("ssp.stall_ticks_measured", n_ticks)
+    return ms

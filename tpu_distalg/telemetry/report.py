@@ -263,6 +263,30 @@ def render(s: dict) -> str:
                 f"p50 {g.get('serve.p50_ms', '?')} ms / "
                 f"p99 {g.get('serve.p99_ms', '?')} ms, {shed} shed, "
                 f"max queue depth {g.get('serve.queue_depth', '?')}")
+        merges = s["counters"].get("ssp.merges")
+        if merges:
+            # the stale-synchronous layer (parallel/ssp.py): observed
+            # contribution staleness (mean/max ages at the merges),
+            # ticks the seeded straggle schedule claimed, ticks the
+            # clock-vector gate held back, membership epochs
+            # (parallel/membership.py ring renegotiations), and — when
+            # the bench's BSP A/B ran — the measured stall time the
+            # window structure avoided
+            g = s["gauges"]
+            c = s["counters"]
+            line = (f"ssp: {merges} merge(s) at bound "
+                    f"{g.get('ssp.bound', '?')}, staleness mean "
+                    f"{g.get('ssp.mean_staleness', '?')} / max "
+                    f"{g.get('ssp.max_staleness', 0)}, "
+                    f"{c.get('ssp.straggle_ticks', 0)} straggled / "
+                    f"{c.get('ssp.gated_ticks', 0)} gated tick(s), "
+                    f"{c.get('ssp.membership_epochs', 0)} membership "
+                    f"epoch(s)")
+            stall = c.get("ssp.stall_ms_avoided")
+            if stall is not None:
+                line += (f", {stall} ms stall avoided vs BSP "
+                         f"(measured A/B)")
+            lines.append(line)
         hid = s["counters"].get("comm.overlap_hidden_ms")
         exposed = s["counters"].get("comm.sync_ms")
         if hid is not None or exposed is not None:

@@ -234,6 +234,37 @@ def restore_newest_with_fallback(ckpt_dir: str, *, logger=None):
             continue
 
 
+def encode_tag(tag: str) -> np.ndarray:
+    """msgpack round-trips arrays, not str — the byte-encoded workload
+    tag every segmented loop (here and ``membership.run_elastic``)
+    stores and compares. One codec, so the tag contract cannot drift
+    between the tick-indexed and window-indexed loops."""
+    return np.frombuffer(tag.encode(), dtype=np.uint8)
+
+
+def decode_tag(payload, default: str) -> str:
+    """Inverse of :func:`encode_tag`; ``default`` for legacy payloads
+    written before tags existed."""
+    if "tag" in payload:
+        return np.asarray(
+            payload["tag"]).tobytes().decode(errors="replace")
+    return default
+
+
+def preempt_boundary_exit(step: int, tag: str) -> None:
+    """The shared preemption contract of every segmented loop: once a
+    request is pending, exit at the boundary AFTER the durable save —
+    emit the record here (the signal handler only sets a flag) and
+    raise :class:`~tpu_distalg.faults.Preempted` (rc 75, never caught
+    by the restart budget). No-op without a pending request."""
+    if not preempt.requested():
+        return
+    tevents.emit("preempted", step=step, tag=tag,
+                 signals=list(preempt.signals_seen()))
+    tevents.counter("preemptions")
+    raise preempt.Preempted(step=step)
+
+
 def run_segmented(
     checkpoint_dir: str,
     checkpoint_every: int,
@@ -296,14 +327,10 @@ def run_segmented(
                 f"past n_iterations={n_iterations}; use a fresh "
                 f"directory or raise n_iterations"
             )
-        if "tag" in payload:
-            saved_tag = np.asarray(
-                payload["tag"]).tobytes().decode(errors="replace")
-        else:
-            # legacy pre-tag payloads ({'w','accs'}) also lack 'state', so
-            # the check below always rejects them: old checkpoints need a
-            # fresh directory, not a silent cross-format resume
-            saved_tag = tag
+        # legacy pre-tag payloads ({'w','accs'}) also lack 'state', so
+        # the check below always rejects them: old checkpoints need a
+        # fresh directory, not a silent cross-format resume
+        saved_tag = decode_tag(payload, tag)
         sig = [(tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
                for v in payload.get("state", [])]
         want = [(tuple(np.asarray(x).shape), str(np.asarray(x).dtype))
@@ -343,8 +370,7 @@ def run_segmented(
         accs_parts.append(np.asarray(accs))
         save(
             checkpoint_dir,
-            # msgpack round-trips arrays, not str — byte-encode the tag
-            {"tag": np.frombuffer(tag.encode(), dtype=np.uint8),
+            {"tag": encode_tag(tag),
              "state": [np.asarray(x) for x in jax.tree.leaves(state)],
              "accs": np.concatenate(accs_parts)},
             step=t,
@@ -352,14 +378,11 @@ def run_segmented(
         prune(checkpoint_dir, keep=keep)
         tevents.emit("checkpoint_saved", step=t, tag=tag)
         tevents.counter("checkpoints_saved")
-        if preempt.requested() and t < n_iterations:
-            # boundary exit AFTER the durable save: the signal handler
-            # only sets a flag (async-signal-safe), so the telemetry
-            # record lands here instead
-            tevents.emit("preempted", step=t, tag=tag,
-                         signals=list(preempt.signals_seen()))
-            tevents.counter("preemptions")
-            raise preempt.Preempted(step=t)
+        if t < n_iterations:
+            # boundary exit AFTER the durable save (the helper no-ops
+            # without a pending request; a finished run never fakes a
+            # preemption)
+            preempt_boundary_exit(t, tag)
     accs = (np.concatenate(accs_parts) if accs_parts
             else np.zeros((0,), np.float32))
     return state, accs, start
