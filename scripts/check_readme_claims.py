@@ -103,6 +103,15 @@ CLAIMS = [
      r"\*\*ALS serving[^*]*\*\*:\s*\*\*([\d\s.]+?)\+\s*req/s", 1.0),
     ("serve_lr_p99_ms",
      r"LR scoring p99 under \*\*([\d.]+?)\s*ms\*\*", 1.0),
+    # partition-engine round (round 15): all three claimed as FLOORS
+    # until the first real-backend round records achieved numbers
+    # (cpu-tagged fallback lines cannot serve as the reference)
+    ("reshard_1gb_gbps",
+     r"reshard sustains\s+\*\*([\d.]+?)\+\s*GB/s\*\*", 1.0),
+    ("ssgd_2d_mesh_step_speedup",
+     r"`--mesh-shape 2x2` runs \*\*([\d.]+?)×\+\*\* the 1-D", 1.0),
+    ("closure_10m_paths_per_sec",
+     r"closure at \*\*([\d\s]+?)\+\s*paths/s\*\*", 1.0),
 ]
 
 #: claims stated as FLOORS ("×+"): the measured value may exceed the
@@ -114,6 +123,9 @@ FLOOR_CLAIMS = frozenset((
     "pagerank_100m_iters_per_sec",
     "serve_als_qps",
     "ssgd_ssp_straggler_speedup",
+    "reshard_1gb_gbps",
+    "ssgd_2d_mesh_step_speedup",
+    "closure_10m_paths_per_sec",
 ))
 
 #: claims stated as CEILINGS ("under X ms" — latency metrics, lower is

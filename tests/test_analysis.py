@@ -1111,3 +1111,90 @@ def test_every_shipped_rule_has_code_and_invariant():
     for rule in analysis.RULES:
         assert engine.CODE_RE.match(rule.code)
         assert rule.invariant and rule.name
+
+
+# ---------------------------------------------------------------- TDA080
+
+SRV = "tpu_distalg/serve/someserve.py"
+
+
+def test_tda080_raw_namedsharding_ctor_flagged():
+    src = """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(mesh, x):
+        s = NamedSharding(mesh, P("data", None))
+        return s
+    """
+    assert codes(lint(src, path=MODEL)) == ["TDA080"]
+    assert codes(lint(src, path=SRV)) == ["TDA080"]
+    # only models/ and serve/ are in scope — parallel/ IS the engine
+    assert "TDA080" not in codes(
+        lint(src, path="tpu_distalg/parallel/somemod.py"))
+
+
+def test_tda080_device_put_with_layout_flagged():
+    src = """
+    import jax
+
+    def place(x, rows):
+        return jax.device_put(x, rows)
+    """
+    assert codes(lint(src, path=MODEL)) == ["TDA080"]
+    kw = """
+    import jax
+
+    def place(x, rows):
+        return jax.device_put(x, device=rows)
+    """
+    assert codes(lint(kw, path=MODEL)) == ["TDA080"]
+    ctor = """
+    import jax
+
+    def place(x, mesh):
+        return jax.device_put(x, data_sharding(mesh, 2))
+    """
+    assert codes(lint(ctor, path=MODEL)) == ["TDA080"]
+
+
+def test_tda080_spec_into_constraint_flagged():
+    src = """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def body(x, mesh):
+        return lax.with_sharding_constraint(x, spec_of(mesh))
+    """
+    assert codes(lint(src, path=MODEL)) == ["TDA080"]
+    # with_sharding_constraint's real keyword is `shardings`
+    kw = """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        return lax.with_sharding_constraint(x, shardings=P("data"))
+    """
+    assert codes(lint(kw, path=MODEL)) == ["TDA080"]
+
+
+def test_tda080_negative_engine_and_program_specs():
+    clean = """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_distalg.parallel import partition
+    from tpu_distalg.parallel.compat import shard_map
+
+    def place(x, mesh, rows):
+        a = partition.put(x, "w", "ssgd", mesh)
+        b = jax.device_put(
+            x, partition.leaf_sharding("ssgd", "X2", mesh))
+        c = jax.device_put(x)          # bare staging: no layout
+        d = lax.with_sharding_constraint(x, rows)  # engine-bound name
+        f = shard_map(lambda v: v, mesh,
+                      in_specs=(P("data"),), out_specs=P())
+        return a, b, c, d, f
+    """
+    assert lint(clean, path=MODEL) == []
+    assert lint(clean, path=SRV) == []

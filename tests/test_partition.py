@@ -1,0 +1,471 @@
+"""Partition-rule engine (``parallel/partition.py``) — PR 11:
+
+  * rule matching — first-match-wins regexes over named pytree leaves
+    (Optax-style nesting included), scalars replicated, an unmatched
+    leaf a HARD error;
+  * device reshard ≡ host gather+re-put BITWISE for every registered
+    table pair, and the wire-byte accounting against the closed-form
+    ring model;
+  * the 2-D mesh geometry grid (1×N, N×1, 2×2) as a config;
+  * golden-hash pins: every model's default-config trajectory under
+    rule-table placement is bitwise-identical to the pre-PR commit
+    (the dense SGD-family pins live in tests/test_comms.py — these
+    cover the placements that PR touched beyond them);
+  * the checkpoint-restore placement and serve-artifact-load seams;
+  * the sparse-closure scale-story satellite (capacity auto-sizing +
+    the documented refusal).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tpu_distalg.parallel import get_mesh
+from tpu_distalg.parallel import partition as pt
+
+
+def _h(x) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(x)).tobytes()).hexdigest()[:16]
+
+
+# ------------------------------------------------------- rule matching
+
+
+def test_rule_match_first_wins_and_nested_state():
+    tbl = pt.RuleTable("t", (
+        (r"inner/.*/mu$", P("data", None)),
+        (r"^w$", P()),
+        (r".*", P("data")),
+    ))
+    tree = {"w": np.zeros((4, 4)),
+            "inner": [{"mu": np.zeros((8, 2)), "nu": np.zeros((8,))}],
+            "step": np.int32(3)}          # scalar: replicated, no rule
+    specs = pt.match_partition_rules(tbl, tree)
+    assert specs["w"] == P()
+    assert specs["inner"][0]["mu"] == P("data", None)
+    assert specs["inner"][0]["nu"] == P("data")   # catch-all
+    assert specs["step"] == P()                   # scalar short-circuit
+
+
+def test_scalar_and_size_one_leaves_replicate():
+    tbl = pt.RuleTable("t", ((r"^x$", P("data")),))
+    specs = pt.match_partition_rules(
+        tbl, {"x": np.zeros(()), "y": np.zeros((1,))})
+    # 'y' has NO rule ('^x$' misses) — but size-1 leaves replicate
+    # before the table is consulted, so no error and P()
+    assert specs == {"x": P(), "y": P()}
+
+
+def test_unmatched_leaf_is_hard_error():
+    tbl = pt.RuleTable("t", ((r"^known$", P("data")),))
+    with pytest.raises(pt.PartitionRuleError) as ei:
+        pt.match_partition_rules(tbl, {"mystery": np.zeros((4, 4))})
+    assert "mystery" in str(ei.value) and "t" in str(ei.value)
+
+
+def test_unknown_table_and_duplicate_register():
+    with pytest.raises(pt.PartitionRuleError):
+        pt.table("no_such_table")
+    with pytest.raises(pt.PartitionRuleError):
+        pt.register(pt.RuleTable("ssgd", ()))  # already registered
+
+
+def test_specs_equal_strips_trailing_none():
+    assert pt.specs_equal(P("data"), P("data", None))
+    assert not pt.specs_equal(P("data"), P(None, "data"))
+
+
+def test_every_model_has_a_registered_table():
+    names = pt.registered()
+    for want in ("lr", "ssgd", "ssgd_tp", "ssgd_feature_sharded",
+                 "ma", "bmuf", "easgd", "local_sgd", "kmeans",
+                 "als_train", "als_serve", "pagerank", "closure_dense",
+                 "ssgd_stream"):
+        assert want in names, want
+
+
+# ------------------------------------------------ reshard ≡ gather+put
+
+
+def _pair_tree(src_name: str):
+    """A tree whose leaves both tables of a registered pair name,
+    shapes divisible by every axis of the 2x2 mesh."""
+    rng = np.random.default_rng(7)
+    if src_name.startswith("als"):
+        return {"U": rng.standard_normal((8, 4)).astype(np.float32),
+                "V": rng.standard_normal((8, 4)).astype(np.float32)}
+    return {"X_data": rng.standard_normal((8, 8)).astype(np.float32),
+            "w": rng.standard_normal((8,)).astype(np.float32),
+            "res": rng.standard_normal((4, 8)).astype(np.float32)}
+
+
+def test_reshard_equals_host_gather_reput_every_registered_pair(
+        mesh_2x2_4dev):
+    for src, dst in pt.RESHARD_PAIRS:
+        tree = _pair_tree(src)
+        placed = pt.place(tree, src, mesh_2x2_4dev)
+        dev = pt.reshard(placed, src, dst, mesh_2x2_4dev, emit=False)
+        host = pt.host_gather_reshard(placed, dst, mesh_2x2_4dev)
+        for name, _ in pt.named_leaves(tree):
+            a, b = np.asarray(dev[name]), np.asarray(host[name])
+            assert a.tobytes() == b.tobytes(), (src, dst, name)
+            # and both equal the source values — a reshard moves
+            # bytes, never changes them
+            assert a.tobytes() == np.ascontiguousarray(
+                tree[name]).tobytes(), (src, dst, name)
+            want = pt.table(dst).spec_for(name, a.shape)
+            got = dev[name].sharding.spec
+            assert pt.specs_equal(got, want), (src, dst, name)
+
+
+def test_ensure_passes_through_placed_leaves(mesh_2x2_4dev):
+    tree = _pair_tree("als_train")
+    placed = pt.place(tree, "als_train", mesh_2x2_4dev)
+    again = pt.ensure(placed, "als_train", mesh_2x2_4dev)
+    assert again["U"] is placed["U"] and again["V"] is placed["V"]
+    # host leaves take the H2D; values land bitwise
+    fresh = pt.ensure(tree, "als_train", mesh_2x2_4dev)
+    assert np.asarray(fresh["U"]).tobytes() == tree["U"].tobytes()
+
+
+# -------------------------------------------------- wire accounting
+
+
+def test_wire_accounting_closed_form(mesh_2x2_4dev, mesh_2x4, mesh4):
+    B = 8 * 4 * 4  # bytes of an (8, 4) f32 leaf
+    # shard → replicated: ring all-gather, B(n-1)/n per shard
+    st = pt.reshard_stats({"U": np.zeros((8, 4), np.float32)},
+                          "als_train", "als_serve", mesh4)
+    leaf = st["leaves"]["U"]
+    assert leaf["op"] == "all_gather"
+    assert leaf["bytes_wire"] == int(B * 3 / 4)
+    assert leaf["bytes_host_roundtrip"] == 2 * B
+    # replicated → shard: local slice, zero wire
+    st = pt.reshard_stats({"V": np.zeros((8, 4), np.float32)},
+                          "als_serve", "als_train", mesh_2x2_4dev)
+    assert st["leaves"]["V"]["op"] == "noop"  # same spec both tables
+    st = pt.reshard_stats({"U": np.zeros((8, 4), np.float32)},
+                          "als_serve", "als_train", mesh_2x2_4dev)
+    assert st["leaves"]["U"]["op"] == "slice"
+    assert st["leaves"]["U"]["bytes_wire"] == 0
+    # shard → shard at equal degree: all-to-all, (B/n)(n-1)/n
+    t2 = pt.RuleTable("t2", ((r"^x$", P(None, "data")),))
+    t1 = pt.RuleTable("t1", ((r"^x$", P("data", None)),))
+    plan = pt._leaf_plan((8, 8), np.float32,
+                         t1.spec_for("x", (8, 8)),
+                         t2.spec_for("x", (8, 8)), mesh4)
+    nb = 8 * 8 * 4
+    assert plan["op"] == "all_to_all"
+    assert plan["bytes_wire"] == int(round((nb / 4) * 3 / 4))
+    # equal-degree axis flip on the 2x2 mesh is ALSO an all-to-all
+    plan = pt._leaf_plan((8, 8), np.float32, P("data", None),
+                         P("model", None), mesh_2x2_4dev)
+    assert plan["op"] == "all_to_all"
+    # degree change (data=2 -> model=4 on the 2x4 mesh): gather+slice
+    # decomposition upper bound, B(n_s-1)/n_s
+    plan = pt._leaf_plan((8, 8), np.float32, P("data", None),
+                         P("model", None), mesh_2x4)
+    assert plan["op"] == "gather_slice"
+    assert plan["bytes_wire"] == int(round(nb * 1 / 2))
+
+
+def test_size_one_axis_spellings_are_noops(mesh4):
+    """Review-caught: on a model=1 mesh, P('data','model') PLACES
+    identically to P('data', None) — the plan must classify the pair
+    as a no-op (zero wire), not account a phantom all-to-all."""
+    st = pt.reshard_stats({"X_data": np.zeros((8, 8), np.float32),
+                          "w": np.zeros((8,), np.float32)},
+                         "ssgd_feature_sharded", "ssgd", mesh4)
+    assert st["leaves"]["X_data"]["op"] == "noop"
+    assert st["leaves"]["w"]["op"] == "noop"
+    assert st["bytes_wire"] == 0 and st["n_moved"] == 0
+
+
+def test_reshard_counters_and_report_line(tmp_path, mesh_2x2_4dev):
+    from tpu_distalg.telemetry import events, report
+
+    d = str(tmp_path / "tel")
+    events.configure(d)
+    try:
+        tree = _pair_tree("als_train")
+        placed = pt.place(tree, "als_train", mesh_2x2_4dev)
+        pt.reshard(placed, "als_train", "als_serve", mesh_2x2_4dev)
+    finally:
+        events.configure(False)
+    s = report.summarize(report.load_events(d))
+    assert s["counters"]["reshard.syncs"] == 1
+    assert s["counters"]["reshard.bytes_wire"] > 0
+    text = report.render(s)
+    assert "reshard:" in text and "host round-trip avoided" in text
+
+
+# ------------------------------------------------ 2-D geometry grid
+
+
+@pytest.mark.parametrize("shape", [(1, 4), (4, 1), (2, 2)])
+def test_mesh_geometry_grid_placement(shape):
+    data, model = shape
+    mesh = get_mesh(data=data, model=model,
+                    devices=jax.devices()[:data * model])
+    tree = {"X2": np.arange(64, dtype=np.float32).reshape(8, 8),
+            "w": np.arange(8, dtype=np.float32)}
+    placed = pt.place(tree, "ssgd_tp", mesh)
+    assert pt.specs_equal(placed["X2"].sharding.spec,
+                          P("data", "model"))
+    assert pt.specs_equal(placed["w"].sharding.spec, P("model"))
+    for k in tree:
+        assert np.asarray(placed[k]).tobytes() == tree[k].tobytes()
+
+
+@pytest.mark.parametrize("shape", [(4, 1), (2, 2), (1, 4)])
+def test_mesh_geometry_grid_ssgd_trains(shape, cancer_data):
+    """--mesh-shape is a CONFIG: the same feature-sharded trainer runs
+    at every (data, model) factorization of 4 devices."""
+    from tpu_distalg.models import ssgd
+
+    data, model = shape
+    mesh = get_mesh(data=data, model=model,
+                    devices=jax.devices()[:data * model])
+    res = ssgd.train(*cancer_data, mesh, ssgd.SSGDConfig(
+        n_iterations=5, feature_sharded=True))
+    assert np.isfinite(np.asarray(res.w)).all()
+
+
+def test_cli_mesh_shape_parse():
+    from tpu_distalg.cli import parse_mesh_shape
+
+    assert parse_mesh_shape("4x2") == (4, 2)
+    assert parse_mesh_shape("1X8") == (1, 8)
+    for bad in ("4", "0x2", "4x", "axb", "4x-2"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+
+
+# ---------------------------------------------------- golden pins
+#
+# Captured at the pre-refactor parent commit on this container's CPU
+# BLAS (the dense ma/bmuf/easgd/local_sgd/ssgd pins live in
+# tests/test_comms.py and still hold) — rule-table placement must be
+# BITWISE-invisible in every trajectory.
+
+_GOLDEN = {
+    "ssgd_fused_gather": ("8377b020a25bc9f2", "0e1f3eb13a30ba2e"),
+    "ssgd_tp_2x2": ("8377b020a25bc9f2", "0e1f3eb13a30ba2e"),
+    "ssgd_feature_sharded_2x2": ("f9922f7350e4e440",
+                                 "1881f0c2e4f7512b"),
+    "ssgd_ssp": ("182c7da6899fc0b8", "3deef5afd58948bc"),
+    "lr": ("c634ad97be0a0a96", "f6feb933335f5106"),
+    "kmeans": ("6513d966ca1a56b1", None),
+    "als": ("0095b0bee38cdf83", "75210c486d7fd894"),
+    "als_2x2": ("39cf9566d45c3af3", "fe05b0375c576a45"),
+    "pagerank": ("cdf4c29b917a486a", None),
+}
+
+
+def test_golden_hashes_under_rule_table_placement(mesh4, mesh_2x2_4dev,
+                                                  cancer_data):
+    from tpu_distalg.models import als, kmeans, pagerank, ssgd
+    from tpu_distalg.models import logistic_regression as lr
+
+    got = {}
+    r = ssgd.train(*cancer_data, mesh4, ssgd.SSGDConfig(
+        n_iterations=20, sampler="fused_gather"))
+    got["ssgd_fused_gather"] = (_h(r.w), _h(r.accs))
+    r = ssgd.train(*cancer_data, mesh_2x2_4dev, ssgd.SSGDConfig(
+        n_iterations=20, sampler="fused_gather", feature_sharded=True))
+    got["ssgd_tp_2x2"] = (_h(r.w), _h(r.accs))
+    r = ssgd.train(*cancer_data, mesh_2x2_4dev, ssgd.SSGDConfig(
+        n_iterations=20, feature_sharded=True))
+    got["ssgd_feature_sharded_2x2"] = (_h(r.w), _h(r.accs))
+    r = ssgd.train(*cancer_data, mesh4, ssgd.SSGDConfig(
+        n_iterations=24, sync="ssp:4"))
+    got["ssgd_ssp"] = (_h(r.w), _h(r.accs))
+    r = lr.train(*cancer_data, mesh4, lr.LRConfig(n_iterations=12))
+    got["lr"] = (_h(r.w), _h(r.accs))
+    pts = np.asarray(
+        np.random.default_rng(1).normal(size=(512, 8)), np.float32)
+    km = kmeans.fit(pts, mesh4, kmeans.KMeansConfig(
+        k=4, n_iterations=5))
+    got["kmeans"] = (_h(km.centers), None)
+    ar = als.fit(mesh4, als.ALSConfig(m=100, n=500, k=10,
+                                      n_iterations=3))
+    got["als"] = (_h(ar.U), _h(ar.V))
+    ar = als.fit(mesh_2x2_4dev, als.ALSConfig(m=100, n=500, k=10,
+                                              n_iterations=3))
+    got["als_2x2"] = (_h(ar.U), _h(ar.V))
+    rng = np.random.default_rng(0)
+    edges = rng.integers(0, 200, size=(1200, 2), dtype=np.int64)
+    pr = pagerank.run(edges, mesh4, pagerank.PageRankConfig(
+        n_iterations=10))
+    got["pagerank"] = (_h(pr.ranks), None)
+
+    for name, want in _GOLDEN.items():
+        assert got[name] == want, \
+            f"{name}: trajectory changed under rule-table placement"
+
+
+@pytest.fixture(scope="module")
+def mesh_2x2_4dev():
+    return get_mesh(data=2, model=2, devices=jax.devices()[:4])
+
+
+# ------------------------------------------------- the three seams
+
+
+def test_checkpoint_restore_placement_seam(tmp_path, mesh_2x2_4dev):
+    """Restored host leaves placed per the table == the original
+    device tree bitwise, in the TABLE's layout (one H2D direct to the
+    final sharding — the restore-placement seam)."""
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    tree = _pair_tree("als_train")
+    placed = pt.place(tree, "als_train", mesh_2x2_4dev)
+    ckpt.save(str(tmp_path), pt.gather(placed), step=3)
+    payload, step = ckpt.restore(str(tmp_path))
+    assert step == 3
+    back = pt.place(payload, "als_train", mesh_2x2_4dev)
+    for name in tree:
+        assert np.asarray(back[name]).tobytes() == \
+            tree[name].tobytes()
+        assert pt.specs_equal(
+            back[name].sharding.spec,
+            pt.table("als_train").spec_for(name, tree[name].shape))
+
+
+def test_serve_artifact_device_vs_host_equivalence(mesh_2x2_4dev):
+    """The serve seam: ``als_model`` fed DEVICE-resident factors in
+    the train layout (reshard path — no host gather) answers bitwise
+    the same as when fed the host copies (place path)."""
+    from tpu_distalg.serve import artifacts
+
+    rng = np.random.default_rng(3)
+    U = rng.standard_normal((8, 4)).astype(np.float32)
+    V = rng.standard_normal((8, 4)).astype(np.float32)
+    host_model = artifacts.als_model(U, V, mesh_2x2_4dev, k_top=3)
+    dev_tree = pt.place({"U": U, "V": V}, "als_train", mesh_2x2_4dev)
+    dev_model = artifacts.als_model(dev_tree["U"], dev_tree["V"],
+                                    mesh_2x2_4dev, k_top=3)
+    ids = [0, 3, 7]
+    a = host_model.predict_batch(ids, max_batch=4)
+    b = dev_model.predict_batch(ids, max_batch=4)
+    for (va, ia), (vb, ib) in zip(a, b):
+        assert np.asarray(va).tobytes() == np.asarray(vb).tobytes()
+        assert np.asarray(ia).tobytes() == np.asarray(ib).tobytes()
+    assert dev_model.meta == host_model.meta
+
+
+def test_serve_artifact_reshard_emits_counters(tmp_path, mesh_2x2_4dev):
+    from tpu_distalg.serve import artifacts
+    from tpu_distalg.telemetry import events, report
+
+    rng = np.random.default_rng(4)
+    U = rng.standard_normal((8, 4)).astype(np.float32)
+    V = rng.standard_normal((8, 4)).astype(np.float32)
+    dev = pt.place({"U": U, "V": V}, "als_train", mesh_2x2_4dev)
+    d = str(tmp_path / "tel")
+    events.configure(d)
+    try:
+        artifacts.als_model(dev["U"], dev["V"], mesh_2x2_4dev, k_top=2)
+    finally:
+        events.configure(False)
+    s = report.summarize(report.load_events(d))
+    assert s["counters"].get("reshard.syncs", 0) >= 1
+
+
+def test_ssp_resume_renegotiation_uses_table_placement(tmp_path,
+                                                       cancer_data):
+    """The renegotiation seam end-to-end: an SSP run checkpointed at 4
+    shards resumes at 2, renegotiates, completes — and per-shard state
+    re-enters in the rule table's layout (partition.ensure inside the
+    segment runner)."""
+    from tpu_distalg.models import ssgd
+
+    mesh4 = get_mesh(data=4, devices=jax.devices()[:4])
+    mesh2 = get_mesh(data=2, devices=jax.devices()[:2])
+    cfg = ssgd.SSGDConfig(n_iterations=16, sync="ssp:4")
+    d = str(tmp_path / "ck")
+    ssgd.train(*cancer_data, mesh4, ssgd.SSGDConfig(
+        n_iterations=8, sync="ssp:4"), checkpoint_dir=d,
+        checkpoint_every=8)
+    res = ssgd.train(*cancer_data, mesh2, cfg, checkpoint_dir=d,
+                     checkpoint_every=8)
+    assert np.isfinite(np.asarray(res.w)).all()
+
+
+# ------------------------------------ sparse-closure scale satellite
+
+
+def test_closure_auto_capacity_grows_and_matches_dense(mesh4):
+    import bench
+    from tpu_distalg.models import transitive_closure as tc
+
+    V = 120
+    edges = bench.closure_dag_edges(V, 5, seed=1)
+    dense = tc.run(edges, mesh4, n_vertices=V)
+    # a deliberately tiny start capacity forces the doubling path
+    sp = tc.run_sparse_auto(edges, mesh4, n_vertices=V,
+                            start_capacity=len(edges) + 4)
+    dm = np.asarray(dense.paths)[:V, :V]
+    assert set(zip(*np.nonzero(dm))) == set(map(tuple, sp.paths))
+    assert sp.n_paths == dense.n_paths
+    assert sp.n_paths == bench.closure_host_count(V, edges)
+
+
+def test_closure_auto_grows_through_checkpoints(tmp_path, mesh4):
+    """Review-caught: an overflowed CHECKPOINTED attempt leaves
+    old-shape (C,)-buffer checkpoints behind — the doubled retry must
+    prune them (run_segmented's signature check would otherwise
+    reject the regrown shapes as a foreign workload and auto-sizing
+    could never complete a checkpointed run)."""
+    import bench
+    from tpu_distalg.models import transitive_closure as tc
+
+    V = 120
+    edges = bench.closure_dag_edges(V, 5, seed=1)
+    sp = tc.run_sparse_auto(edges, mesh4, n_vertices=V,
+                            start_capacity=len(edges) + 4,
+                            checkpoint_dir=str(tmp_path / "ck"),
+                            checkpoint_every=4)
+    assert sp.n_paths == bench.closure_host_count(V, edges)
+
+
+def test_closure_auto_start_capacity_below_edges_grows(mesh4):
+    """Review-caught: an explicit start_capacity below the edge count
+    is a growth starting point, not run_sparse's hard 'capacity < edge
+    count' error."""
+    import bench
+    from tpu_distalg.models import transitive_closure as tc
+
+    V = 120
+    edges = bench.closure_dag_edges(V, 5, seed=1)
+    sp = tc.run_sparse_auto(edges, mesh4, n_vertices=V,
+                            start_capacity=8)
+    assert sp.n_paths == bench.closure_host_count(V, edges)
+
+
+def test_closure_refusal_is_documented(mesh4):
+    import bench
+    from tpu_distalg.models import transitive_closure as tc
+
+    edges = bench.closure_dag_edges(200, 5, seed=0)
+    with pytest.raises(ValueError) as ei:
+        tc.run_sparse_auto(edges, mesh4, n_vertices=200,
+                           budget_bytes=1 << 14)
+    msg = str(ei.value)
+    assert "refused" in msg and "budget" in msg and "dense" in msg
+
+
+def test_bench_new_metrics_registered():
+    import bench
+
+    for name in ("reshard_1gb_gbps", "ssgd_2d_mesh_step_speedup",
+                 "closure_10m_paths_per_sec"):
+        assert name in bench.ALL_METRIC_NAMES
+        assert name in bench._METRIC_UNITS

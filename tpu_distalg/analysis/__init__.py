@@ -36,6 +36,11 @@ TDA070      SSP discipline in ``tpu_distalg/parallel/``: no unseeded
             stale-synchronous layer), and no unbounded host-side wait
             on the clock vector (a departed shard's frozen clock must
             time out, not wedge)
+TDA080      no raw ``NamedSharding``/placement-spec construction or
+            ``device_put`` with a hand-built layout in
+            ``tpu_distalg/models/`` / ``tpu_distalg/serve/`` — every
+            placement routes through the partition-rule engine
+            (``parallel/partition.py`` rule tables, PR 11)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
@@ -56,6 +61,7 @@ from tpu_distalg.analysis.engine import (
     lint_source,
 )
 from tpu_distalg.analysis.pallas import RULES as _PALLAS
+from tpu_distalg.analysis.partition import RULES as _PARTITION
 from tpu_distalg.analysis.seams import RULES as _SEAMS
 from tpu_distalg.analysis.serve import RULES as _SERVE
 from tpu_distalg.analysis.ssp import RULES as _SSP
@@ -64,7 +70,7 @@ from tpu_distalg.analysis.tracing import RULES as _TRACING
 #: every shipped rule, in code order
 RULES = tuple(sorted(
     _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
-    + _SERVE + _SSP,
+    + _SERVE + _SSP + _PARTITION,
     key=lambda r: r.code))
 
 __all__ = [

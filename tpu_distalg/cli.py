@@ -14,8 +14,32 @@ Run ``--emulate N`` to execute on N virtual CPU devices (Spark
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 import time
+
+
+def parse_mesh_shape(text: str) -> tuple[int, int]:
+    """``'DxM'`` → ``(data, model)`` — the 2-D mesh config the
+    partition-rule engine makes a knob instead of a code path
+    (``parallel/partition.py``). ``'8x1'`` is pure data parallel,
+    ``'2x4'`` puts 4-way model parallelism inside each data replica."""
+    m = re.fullmatch(r"(\d+)[xX](\d+)", text.strip())
+    if not m or int(m.group(1)) < 1 or int(m.group(2)) < 1:
+        raise ValueError(
+            f"--mesh-shape wants DATAxMODEL (e.g. 4x2), got {text!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
+def _add_mesh_shape(p) -> None:
+    """The one definition of the ``--mesh-shape`` flag (six subcommands
+    carry it — a copy per parser would drift like the ``--n-slices``
+    duplication it extends)."""
+    p.add_argument("--mesh-shape", type=str, default=None,
+                   metavar="DxM",
+                   help="full 2-D mesh geometry data x model (e.g. "
+                        "2x2); placement falls out of the workload's "
+                        "partition rule table — replaces --n-slices")
 
 
 def _mesh(args):
@@ -23,6 +47,31 @@ def _mesh(args):
 
     # MeshContext is the SparkSession analogue: the one runtime object
     # every workload receives (its .mesh)
+    shape = getattr(args, "mesh_shape", None)
+    if shape:
+        if getattr(args, "n_slices", 0) > 0:
+            raise SystemExit(
+                "--mesh-shape and --n-slices both set: --mesh-shape "
+                "IS the full (data x model) geometry; drop --n-slices")
+        try:
+            data, model = parse_mesh_shape(shape)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        # only the workloads whose rule tables name a model-axis
+        # placement consume model>1 — everywhere else those devices
+        # would be silent passengers, so say so instead of wasting
+        # them quietly (ssgd validates and engages the tp split in
+        # its own branch; als shards V over the model axis)
+        if model > 1 and getattr(args, "cmd", None) not in (
+                "ssgd", "als"):
+            print(
+                f"[mesh] warning: --mesh-shape {data}x{model} puts "
+                f"{model}-way model parallelism on a workload whose "
+                f"rule table has no model-axis placement — those "
+                f"devices will idle; use --mesh-shape "
+                f"{data * model}x1 (or --n-slices {data * model}) "
+                f"for full data parallelism", file=sys.stderr)
+        return MeshContext.create(data=data, model=model).mesh
     return MeshContext.create(
         data=args.n_slices if args.n_slices > 0 else None
     ).mesh
@@ -32,6 +81,7 @@ def _add_common(p, n_iterations, eta=None, frac=None, samplers=None,
                 sync=False):
     p.add_argument("--n-slices", type=int, default=0,
                    help="data-axis size; 0 = all devices")
+    _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=n_iterations)
     if eta is not None:
         p.add_argument("--eta", type=float, default=eta)
@@ -228,6 +278,7 @@ def main(argv=None):
 
     p = sub.add_parser("kmeans")
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--n-iterations", type=int, default=5)
     p.add_argument("--converge-dist", type=float, default=None)
@@ -255,6 +306,7 @@ def main(argv=None):
 
     p = sub.add_parser("pagerank")
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=10)
     p.add_argument("--q", type=float, default=0.15)
     p.add_argument("--mode", default=None,
@@ -311,6 +363,7 @@ def main(argv=None):
 
     p = sub.add_parser("closure", help="transitive closure")
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--n-vertices", type=int, default=0)
     p.add_argument("--sparse", action="store_true",
                    help="sort-dedup path-set closure (O(closure) memory "
@@ -326,6 +379,7 @@ def main(argv=None):
 
     p = sub.add_parser("als", help="ALS matrix decomposition")
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--m", type=int, default=100)
     p.add_argument("--n", type=int, default=500)
     p.add_argument("--k", type=int, default=10)
@@ -383,6 +437,7 @@ def main(argv=None):
 
     p = sub.add_parser("mc", help="Monte-Carlo pi")
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--n", type=int, default=400_000)
     p.add_argument("--max-restarts", type=int, default=0,
                    help="retry the (stateless, deterministic) estimate "
@@ -400,6 +455,7 @@ def main(argv=None):
                             "kmeans_stream", "pagerank_stream",
                             "serve", "ssp"])
     p.add_argument("--n-slices", type=int, default=0)
+    _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=None,
                    help="override the workload's small default")
     p.add_argument("--checkpoint-every", type=int, default=None)
@@ -590,6 +646,19 @@ def _dispatch(args, jax):
                 fused_pack=args.fused_pack,
                 shuffle_seed=args.shuffle_seed,
                 comm=args.comm, sync=args.sync)
+            n_model = int(mesh.shape["model"])
+            if n_model > 1:
+                # a 2-D --mesh-shape IS the tp request: the feature
+                # dim shards over the model axis per the ssgd_tp /
+                # ssgd_feature_sharded rule tables — a config, not a
+                # code path (parallel/partition.py)
+                if args.sampler not in ("bernoulli", "fused_gather"):
+                    raise SystemExit(
+                        f"--mesh-shape with model={n_model} shards "
+                        f"the feature dim, which composes with "
+                        f"sampler=bernoulli or fused_gather (got "
+                        f"{args.sampler!r})")
+                kw["feature_sharded"] = True
             if args.sampler != "fused_train" and \
                     args.mega_steps is not None:
                 raise SystemExit(

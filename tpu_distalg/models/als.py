@@ -46,9 +46,7 @@ from tpu_distalg.ops import linalg
 from tpu_distalg.parallel import (
     DATA_AXIS,
     data_parallel,
-    data_sharding,
     pad_rows,
-    replicated_sharding,
     tree_allreduce_sum,
 )
 from tpu_distalg.utils import metrics
@@ -103,12 +101,9 @@ def model_padded_n(config: ALSConfig, mesh: Mesh) -> int:
 def make_fit_fn(mesh: Mesh, config: ALSConfig):
     import warnings
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from tpu_distalg.parallel import MODEL_AXIS
+    from tpu_distalg.parallel import MODEL_AXIS, partition
 
     denom = config.m * config.n  # true element count, not padded
-    rows = data_sharding(mesh, ndim=2)
     # shard the item factor over the model axis — the model-parallel
     # einsum SURVEY.md §2.3 calls for, replacing the reference's
     # broadcast of full V to every task (:46-48). fit() pads R's
@@ -118,9 +113,9 @@ def make_fit_fn(mesh: Mesh, config: ALSConfig):
     n_model = mesh.shape[MODEL_AXIS]
     n_pad = model_padded_n(config, mesh)
 
-    def _v_sharding(n_cols: int):
+    def _v_engaged(n_cols: int) -> bool:
         if n_model <= 1:
-            return None
+            return False
         if n_cols % n_model:
             warnings.warn(
                 f"ALS model axis DISENGAGED: R has {n_cols} columns, "
@@ -128,22 +123,22 @@ def make_fit_fn(mesh: Mesh, config: ALSConfig):
                 f"will be replicated. Pad R's columns to {n_pad} "
                 "(als.fit does) to engage the model-parallel sharding.",
                 stacklevel=3)
-            return None
-        return NamedSharding(mesh, P(MODEL_AXIS, None))
+            return False
+        return True
 
     def fit(R, U0, V0):
-        v_sharding = _v_sharding(R.shape[1])
+        v_engaged = _v_engaged(R.shape[1])
         def sweep(carry, _):
             U, V = carry
             # U-update: (VᵀV + λ·n·I) uᵢ = Vᵀ R[i,:]  (:52-54, :24-33)
             G_v = linalg.gram(V, config.lam, config.n)
             U = linalg.solve_factor_block(G_v, V, R)
-            U = lax.with_sharding_constraint(U, rows)
+            U = partition.constrain(U, "U", "als_train", mesh)
             # V-update against Rᵀ: (UᵀU + λ·m·I) vⱼ = Uᵀ R[:,j]  (:60-62)
             G_u = linalg.gram(U, config.lam, config.m)
             V = linalg.solve_factor_block(G_u, U, R.T)
-            if v_sharding is not None:
-                V = lax.with_sharding_constraint(V, v_sharding)
+            if v_engaged:
+                V = partition.constrain(V, "V", "als_train", mesh)
             # padded rows are exactly zero on both sides; 'highest'
             # precision keeps the reconstruction error measurement from
             # being floored by TPU bf16 matmul passes
@@ -192,11 +187,11 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
     V0 = np.zeros((n_pad, config.k), dtype=np.float32)
     V0[: config.n] = rng.random((config.n, config.k), dtype=np.float32)
 
-    rows = data_sharding(mesh, ndim=2)
-    repl = replicated_sharding(mesh)
-    R_dev = jax.device_put(jnp.asarray(R_padded), rows)
-    U_dev = jax.device_put(jnp.asarray(U0), rows)
-    V_dev = jax.device_put(jnp.asarray(V0), repl)
+    from tpu_distalg.parallel import partition
+
+    R_dev = partition.put(R_padded, "R", "als_train", mesh)
+    U_dev = partition.put(U0, "U", "als_train", mesh)
+    V_dev = partition.put(V0, "V0", "als_train", mesh)
 
     if checkpoint_dir is None:
         fn = make_fit_fn(mesh, config)
@@ -210,8 +205,8 @@ def fit(mesh: Mesh, config: ALSConfig = ALSConfig(),
     def run_seg(fn, state, t0):
         del t0  # sweeps carry no PRNG; the factors are the whole state
         U, V = state
-        U = jax.device_put(jnp.asarray(U), rows)
-        V = jax.device_put(jnp.asarray(V), repl)
+        U = partition.put(U, "U", "als_train", mesh)
+        V = partition.put(V, "V0", "als_train", mesh)
         U, V, errs = fn(R_dev, U, V)
         return (U, V), errs
 
@@ -316,10 +311,11 @@ def fit_streamed(dataset, config: ALSConfig | None = None, *,
     solve_fn, v_update_fn, rmse_fn, gram_fn = _make_streamed_block_fns(
         mesh, config, n)
 
+    from tpu_distalg.parallel import partition
+
     rng = np.random.default_rng(config.seed + 1)
-    repl = replicated_sharding(mesh)
-    V = jax.device_put(
-        jnp.asarray(rng.random((n, k), dtype=np.float32)), repl)
+    V = partition.put(rng.random((n, k), dtype=np.float32),
+                      "V0", "als_train", mesh)
     # every sweep streams the blocks in order: one block per shard per
     # step, the same LOCAL block id on every shard
     ids = np.tile(np.arange(nb, dtype=np.int64)[:, None, None],

@@ -366,10 +366,8 @@ def _train_ssp(
     count re-derives per-replica state from the replicated center)."""
     import numpy as np
 
-    from jax.sharding import NamedSharding
-
     from tpu_distalg.models.ssgd import window_accs_to_ticks
-    from tpu_distalg.parallel import comms, membership
+    from tpu_distalg.parallel import comms, membership, partition
     from tpu_distalg.parallel import ssp as pssp
 
     spec = pssp.SyncSpec.parse(config.sync)
@@ -397,7 +395,6 @@ def _train_ssp(
     extra[T:] = 0  # pad rounds don't exist: no interference, no busy
     extra = extra.reshape(n_win, s, n_shards)
     sync = _comm_sync(mesh, config, D)
-    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
 
     def fresh_shard_state(w_host):
         """Per-replica state derived from the replicated center — the
@@ -413,8 +410,8 @@ def _train_ssp(
         ws_new, res_new = fresh_shard_state(w)
         return (w, ws_new,
                 np.asarray(saved_leaves[2], np.float32),   # delta
-                membership.redistribute_clocks(saved_leaves[3],
-                                               n_shards),
+                np.asarray(membership.redistribute_clocks(
+                    saved_leaves[3], n_shards), np.int32),
                 np.zeros((n_shards,), np.int32),           # stale
                 res_new)
 
@@ -446,15 +443,23 @@ def _train_ssp(
 
     def run_seg(fn, state, win0, n_win_seg, epoch):
         del epoch
-        w, ws, delta, clocks, stale, res = state
-        ws = jax.device_put(jnp.asarray(np.asarray(ws)), shard2)
-        res = jax.device_put(jnp.asarray(np.asarray(res)), shard2)
+        # idempotent rule-table placement: device-resident state in
+        # the table layout passes through untouched (the old
+        # np.asarray + device_put spelling paid a host round trip
+        # every segment); restored/renegotiated host leaves take one
+        # H2D direct to their final layout
+        st = partition.ensure(
+            {"w": state[0] if isinstance(state[0], jax.Array)
+             else np.asarray(state[0], np.float32),
+             "ws": state[1],
+             "delta": state[2] if isinstance(state[2], jax.Array)
+             else np.asarray(state[2], np.float32),
+             "clocks": state[3], "stale": state[4], "res": state[5]},
+            "local_sgd", mesh)
         out = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
-                 jnp.asarray(np.asarray(w, np.float32)), ws,
-                 jnp.asarray(np.asarray(delta, np.float32)),
-                 jnp.asarray(np.asarray(clocks, np.int32)),
-                 jnp.asarray(np.asarray(stale, np.int32)),
-                 res, jnp.asarray(extra[win0:win0 + n_win_seg]),
+                 st["w"], st["ws"], st["delta"], st["clocks"],
+                 st["stale"], st["res"],
+                 jnp.asarray(extra[win0:win0 + n_win_seg]),
                  jnp.int32(win0))
         return out[:6], out[6:]
 
@@ -799,9 +804,8 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: LocalSGDConfig):
     call as ``fn(X2, X_test_padded, y_test, w0, ws0, delta0)``."""
     import numpy as np
 
-    from jax.sharding import NamedSharding
-
     from tpu_distalg.ops import pallas_kernels
+    from tpu_distalg.parallel import partition
 
     n_shards = mesh.shape[DATA_AXIS]
     D = X_train.shape[1]
@@ -813,7 +817,7 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: LocalSGDConfig):
         block_rows=config.gather_block_rows * n_shards,
         shuffle_seed=config.shuffle_seed,
     )
-    X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
+    X2 = partition.put(X2, "X2", "local_sgd", mesh)
     d_t = meta["d_total"]
     n_replicas = n_shards
     k_init = prng.root_key(config.init_seed)
@@ -848,14 +852,11 @@ def _train_comm(mesh, config: LocalSGDConfig, d, data_args, w0, ws0,
     the carry/checkpoint state is ``(w, ws, delta, residual)`` — the
     error-feedback residual is per-replica like ``ws`` and persists
     across segments for bitwise resume."""
-    from jax.sharding import NamedSharding
-
-    from tpu_distalg.parallel import comms
+    from tpu_distalg.parallel import comms, partition
     from tpu_distalg.utils import metrics as _metrics
 
     sync = _comm_sync(mesh, config, d)
-    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
-    res0 = jax.device_put(jnp.asarray(sync.init_state()), shard2)
+    res0 = partition.put(sync.init_state(), "res", "local_sgd", mesh)
 
     if checkpoint_dir is None:
         fn = fn if fn is not None else make_fn(config.n_iterations)
@@ -868,8 +869,8 @@ def _train_comm(mesh, config: LocalSGDConfig, d, data_args, w0, ws0,
 
     def run_seg(seg_fn, state, t0):
         w, ws, delta, res = state
-        ws = jax.device_put(jnp.asarray(ws), shard2)
-        res = jax.device_put(jnp.asarray(res), shard2)
+        ws = partition.put(ws, "ws", "local_sgd", mesh)
+        res = partition.put(res, "res", "local_sgd", mesh)
         w, ws, delta, res, accs = seg_fn(
             *data_args, jnp.asarray(w), ws, jnp.asarray(delta), res,
             t0=t0)
@@ -925,14 +926,12 @@ def _train_fused(
         metrics.guard_finite((w, ws), "local-SGD (fused) models")
         return TrainResult(w=w[:D], ws=ws[:, :D], accs=accs)
 
-    from jax.sharding import NamedSharding
+    from tpu_distalg.parallel import partition
     from tpu_distalg.utils import checkpoint as ckpt
-
-    ws_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
 
     def run_seg(seg_fn, state, t0):
         w, ws, delta = state
-        ws = jax.device_put(jnp.asarray(ws), ws_sharding)
+        ws = partition.put(ws, "ws", "local_sgd", mesh)
         w, ws, delta, accs = seg_fn(
             X2, X_te, y_te, jnp.asarray(w), ws, jnp.asarray(delta),
             t0=t0,
@@ -1029,15 +1028,14 @@ def train(
         metrics.guard_finite((w, ws), "local-SGD models")
         return TrainResult(w=w, ws=ws, accs=accs)
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_distalg.parallel import partition
     from tpu_distalg.utils import checkpoint as ckpt
-
-    ws_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
 
     def run_seg(fn, state, t0):
         w, ws, delta = state
-        # restored per-replica models arrive as host arrays — re-shard
-        ws = jax.device_put(jnp.asarray(ws), ws_sharding)
+        # restored per-replica models arrive as host arrays — the
+        # rule table re-shards them (one H2D direct to final layout)
+        ws = partition.put(ws, "ws", "local_sgd", mesh)
         w, ws, delta, accs = fn(
             Xs.data, ys.data, Xs.mask, X_te, y_te,
             jnp.asarray(w), ws, jnp.asarray(delta), t0=t0,

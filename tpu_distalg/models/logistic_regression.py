@@ -148,15 +148,11 @@ def train(
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
 
     if config.comm != "dense":
-        from jax.sharding import NamedSharding
-
-        from tpu_distalg.parallel import comms
+        from tpu_distalg.parallel import comms, partition
 
         d = X_train.shape[1]
         sync = _comm_sync(mesh, config, d)
-        res_sharding = NamedSharding(mesh, P("data", None))
-        res0 = jax.device_put(
-            jnp.asarray(sync.init_state()), res_sharding)
+        res0 = partition.put(sync.init_state(), "res", "lr", mesh)
         if checkpoint_dir is None:
             fn = make_train_fn(mesh, config, d=d)
             w, accs, _ = fn(
@@ -169,7 +165,7 @@ def train(
 
         def run_seg(fn, state, t0):
             w, res = state
-            res = jax.device_put(jnp.asarray(res), res_sharding)
+            res = partition.put(res, "res", "lr", mesh)
             w, accs, res = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
                               jnp.asarray(w), res, t0=t0)
             return (w, res), accs

@@ -60,7 +60,7 @@ from tpu_distalg.ops import graph as gops
 from tpu_distalg.parallel import (
     DATA_AXIS,
     data_parallel,
-    data_sharding,
+    partition,
     tree_allreduce_sum,
 )
 
@@ -215,15 +215,14 @@ def prepare_device_spmv(el: gops.EdgeList, mesh: Mesh,
         tevents.counter("spmv_plan_rejections")
     if plan is None:
         return None
-    s1 = data_sharding(mesh, 1)
-    s2 = data_sharding(mesh, 2)
-    put1 = lambda a: jax.device_put(jnp.asarray(a), s1)  # noqa: E731
-    put2 = lambda a: jax.device_put(jnp.asarray(a), s2)  # noqa: E731
+    put = lambda a, n: partition.put(a, n, "pagerank", mesh)  # noqa: E731
     return DeviceSpMV(
-        gbase=put1(plan.gbase), sbase=put1(plan.sbase),
-        src_lane=put2(plan.src_lane), src_row=put2(plan.src_row),
-        dst_row=put2(plan.dst_row), dst_lane=put2(plan.dst_lane),
-        w_e=put2(plan.w_e), rg=plan.rg, ws=plan.ws, r8=plan.r8,
+        gbase=put(plan.gbase, "gbase"), sbase=put(plan.sbase, "sbase"),
+        src_lane=put(plan.src_lane, "src_lane"),
+        src_row=put(plan.src_row, "src_row"),
+        dst_row=put(plan.dst_row, "dst_row"),
+        dst_lane=put(plan.dst_lane, "dst_lane"),
+        w_e=put(plan.w_e, "w_e"), rg=plan.rg, ws=plan.ws, r8=plan.r8,
         blk=plan.blk, n_chunks=plan.n_chunks)
 
 
@@ -248,8 +247,7 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
     inv_deg = _inv_out_degree(el)
     V = el.n_vertices
     n_shards = mesh.shape[DATA_AXIS]
-    shard1 = data_sharding(mesh, 1)
-    put = lambda a: jax.device_put(jnp.asarray(a), shard1)  # noqa: E731
+    put = lambda a, n: partition.put(a, n, "pagerank", mesh)  # noqa: E731
     has_out = (deg > 0).astype(np.float32)
     if light:
         # the spmv path deletes src/dst/w_e/emask on its first line —
@@ -258,7 +256,8 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
         z = np.zeros(n_shards, np.int32)
         zf = np.zeros(n_shards, np.float32)
         return DeviceEdges(
-            src=put(z), dst=put(z), w_e=put(zf), emask=put(zf),
+            src=put(z, "src"), dst=put(z, "dst"), w_e=put(zf, "w_e"),
+            emask=put(zf, "emask"),
             inv_deg=jnp.asarray(inv_deg), has_out=jnp.asarray(has_out),
             n_vertices=V, n_ref=float(has_out.sum()), plan=None)
 
@@ -292,11 +291,10 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
         # the padded dst is exactly what the plan encoded
         dst_p = (plan.row.reshape(-1) * 128 + plan.lane.reshape(-1)
                  ).astype(np.int32)
-        shard2 = data_sharding(mesh, 2)
         dplan = DevicePlan(
-            base=put(plan.base),
-            row=jax.device_put(jnp.asarray(plan.row), shard2),
-            lane=jax.device_put(jnp.asarray(plan.lane), shard2),
+            base=put(plan.base, "base"),
+            row=put(plan.row, "row"),
+            lane=put(plan.lane, "lane"),
             w=plan.w, blk=plan.blk, r8=plan.r8, n_chunks=plan.n_chunks,
         )
     else:
@@ -310,7 +308,8 @@ def prepare_device_edges(el: gops.EdgeList, mesh: Mesh,
         emask[E:] = 0.0
         dplan = None
     return DeviceEdges(
-        src=put(src_p), dst=put(dst_p), w_e=put(w_p), emask=put(emask),
+        src=put(src_p, "src"), dst=put(dst_p, "dst"),
+        w_e=put(w_p, "w_e"), emask=put(emask, "emask"),
         inv_deg=jnp.asarray(inv_deg), has_out=jnp.asarray(has_out),
         n_vertices=V, n_ref=float(has_out.sum()), plan=dplan,
     )

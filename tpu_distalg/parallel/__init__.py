@@ -36,7 +36,8 @@ from tpu_distalg.parallel.comms import (
     CommSync,
     make_sync,
 )
-from tpu_distalg.parallel import membership, ssp
+from tpu_distalg.parallel import membership, partition, ssp
+from tpu_distalg.parallel.partition import RuleTable
 from tpu_distalg.parallel.ssp import SyncSpec
 from tpu_distalg.parallel.spmd import data_parallel, replica_index
 from tpu_distalg.parallel.ring import (
@@ -56,10 +57,12 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "MeshContext",
+    "RuleTable",
     "ShardedMatrix",
     "SyncSpec",
     "make_sync",
     "membership",
+    "partition",
     "ssp",
     "all_gather",
     "all_to_all",

@@ -161,6 +161,18 @@ def _true_rows(M: np.ndarray) -> int:
     return int(nz[-1]) + 1 if len(nz) else 0
 
 
+def _true_rows_device(M) -> int:
+    """:func:`_true_rows` for a device-resident factor matrix: the
+    reduction runs on device and only the resulting SCALAR crosses to
+    the host — the old spelling's ``np.asarray(M)`` gathered the whole
+    matrix, defeating the device-side handoff."""
+    import jax.numpy as jnp
+
+    nz = jnp.any(M != 0, axis=1)
+    last = jnp.max(jnp.where(nz, jnp.arange(M.shape[0]) + 1, 0))
+    return int(last)
+
+
 def als_model(U, V, mesh, *, k_top: int = 10, merge: str = "sparse",
               use_fused: bool | None = None, block_items: int = 1024,
               n_items: int | None = None, name: str = "als",
@@ -189,21 +201,35 @@ def als_model(U, V, mesh, *, k_top: int = 10, merge: str = "sparse",
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_distalg.ops import pallas_topk as pt
-    from tpu_distalg.parallel import MODEL_AXIS, comms, replicate
+    from tpu_distalg.parallel import MODEL_AXIS, comms, partition
     from tpu_distalg.parallel.compat import shard_map
 
     if merge not in ("sparse", "dense"):
         raise ValueError(f"merge must be 'sparse' or 'dense', "
                          f"got {merge!r}")
-    U = np.asarray(U, np.float32)
-    V = np.asarray(V, np.float32)
+    # device-resident factors (the in-memory train→serve handoff —
+    # bench, chaos, a Server built on the training result) stay on
+    # device: the train→serve layout change runs as a device-side
+    # reshard below instead of the old np.asarray gather + re-put
+    dev_in = isinstance(U, jax.Array) and isinstance(V, jax.Array)
+    if dev_in:
+        U = jnp.asarray(U, jnp.float32)
+        V = jnp.asarray(V, jnp.float32)
+    else:
+        U = np.asarray(U, np.float32)
+        V = np.asarray(V, np.float32)
     if U.shape[1] != V.shape[1]:
         raise ValueError(
             f"U {U.shape} vs V {V.shape}: factor ranks differ")
-    n_true = int(n_items) if n_items is not None else _true_rows(V)
+    if n_items is not None:
+        n_true = int(n_items)
+    elif dev_in:
+        n_true = _true_rows_device(V)  # one scalar D2H, not a gather
+    else:
+        n_true = _true_rows(V)
     if not 0 < n_true <= V.shape[0]:
         raise ValueError(
             f"n_items={n_true} invalid for V with {V.shape[0]} rows")
@@ -216,12 +242,22 @@ def als_model(U, V, mesh, *, k_top: int = 10, merge: str = "sparse",
     # are zero AND index-masked (>= n_true scores -inf) — doubly inert
     n_pad = -(-V.shape[0] // n_model) * n_model
     if n_pad != V.shape[0]:
-        V = np.pad(V, ((0, n_pad - V.shape[0]), (0, 0)))
+        pad = ((0, n_pad - V.shape[0]), (0, 0))
+        V = jnp.pad(V, pad) if dev_in else np.pad(V, pad)
     local_n = n_pad // n_model
 
-    U_dev = replicate(jnp.asarray(U), mesh)
-    V_dev = jax.device_put(
-        jnp.asarray(V), NamedSharding(mesh, P(MODEL_AXIS, None)))
+    if dev_in:
+        # the train-layout → serve-layout seam, device-side: U
+        # all-gathers to replicated, V slices to its model shards —
+        # the collective program arXiv:2112.01075 argues for, with
+        # the wire bytes accounted in the reshard.* counters
+        placed = partition.reshard({"U": U, "V": V},
+                                   "als_train", "als_serve", mesh)
+    else:
+        # host factors (a disk artifact): one H2D per leaf direct to
+        # the serve layout
+        placed = partition.place({"U": U, "V": V}, "als_serve", mesh)
+    U_dev, V_dev = placed["U"], placed["V"]
 
     def _score(q, Vl, off, nv):
         if fused:

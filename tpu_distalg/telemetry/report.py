@@ -302,6 +302,20 @@ def render(s: dict) -> str:
             lines.append(
                 f"comm overlap: {hid} ms hidden behind compute "
                 f"({frac:.0%} of {total} ms comm time)")
+        resh = s["counters"].get("reshard.syncs")
+        if resh:
+            # device-side resharding (parallel/partition.py): layout
+            # changes lowered to on-device collective programs; the
+            # avoided figure is what the old host gather+re-put would
+            # have moved over PCIe for the same transitions
+            c = s["counters"]
+            lines.append(
+                f"reshard: {resh} layout change(s), "
+                f"{c.get('reshard.leaves', 0)} leaf move(s), "
+                f"{c.get('reshard.bytes_wire', 0) / 1e6:.1f} MB wire "
+                f"(host round-trip avoided: "
+                f"{c.get('reshard.bytes_host_avoided', 0) / 1e6:.1f}"
+                f" MB)")
     if s["gauges"]:
         lines.append("gauges: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["gauges"].items())))
