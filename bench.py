@@ -1126,6 +1126,130 @@ def run_ssp_straggler_speedup(mesh, emit, *, steps=64, repeats=3,
     emit(line)
 
 
+#: the canonical cluster bench geometry: 3 worker slots, one seeded
+#: kill mid-run — the elastic-vs-restart A/B and the replay tests pin
+#: to these numbers
+CLUSTER_SLOTS = 3
+CLUSTER_KILL_SLOT = 1
+
+
+def run_cluster_bench(emit, *, fast: bool = False):
+    """The multi-process elastic runtime's headline pair
+    (tpu_distalg/cluster/), shared by the bench ``cluster`` phase and
+    the CPU-fallback tier (the cluster runs on host processes/threads
+    by construction — no TPU dependency, honest everywhere):
+
+    ``ssgd_cluster_elastic_speedup`` — FULL measured wall clock of a
+    3-worker local cluster run that loses one worker to a seeded
+    ``kill -9`` mid-run, ELASTIC policy (training continues at
+    reduced quorum, the replacement rejoins by pulling the center) vs
+    the RESTART-policy baseline (any death aborts; the whole cluster
+    respawns from the durable checkpoint — the gang-scheduled
+    BSP-restart world the reference's process model lives in). Same
+    plan, same task, same thread-mode workers in both arms, so the
+    ratio isolates the failure-handling policy: the baseline re-pays
+    the respawn plus every window since the last checkpoint.
+
+    ``cluster_push_pull_ms`` — median measured push→commit→pull round
+    trip at the PS tier on an otherwise idle single-worker cluster
+    (framed delta up, merge, framed center back): the transport +
+    merge cost floor every window pays.
+
+    Both RAISE instead of emitting fabricated values when a run fails
+    to complete (the serve-round-3 lesson: a fabricated number poisons
+    the tripwire reference).
+    """
+    import dataclasses
+    import tempfile
+
+    from tpu_distalg import cluster as clus
+
+    windows = 8 if fast else 24
+    s = 2 if fast else 4
+    ce = 3 if fast else 8
+    kill_w = windows // 2
+    hit = kill_w * CLUSTER_SLOTS + CLUSTER_KILL_SLOT
+    plan = f"seed=7;cluster:worker@{hit}=kill"
+    task = clus.TrainTask(n_rows=1024 if fast else 4096)
+    base = clus.ClusterConfig(
+        n_slots=CLUSTER_SLOTS, n_windows=windows, staleness=s,
+        heartbeat_timeout=3.0, plan_spec=plan, train=task,
+        checkpoint_every=ce)
+
+    # BOTH arms pay the same periodic checkpoint cadence — the ratio
+    # must isolate the failure POLICY, not gift the elastic arm the
+    # restart arm's checkpoint I/O
+    with tempfile.TemporaryDirectory(prefix="tda_cluster_e_") as d:
+        res_e = clus.run_local_cluster(
+            dataclasses.replace(base, checkpoint_dir=d),
+            spawn="thread", timeout=300.0)
+    with tempfile.TemporaryDirectory(prefix="tda_cluster_r_") as d:
+        res_r = clus.run_local_cluster(
+            dataclasses.replace(base, policy="restart",
+                                checkpoint_dir=d),
+            spawn="thread", timeout=300.0)
+    for name, res in (("elastic", res_e), ("restart", res_r)):
+        if res["version"] != windows:
+            raise RuntimeError(
+                f"cluster {name} arm stopped at window "
+                f"{res['version']}/{windows} — no speedup can be "
+                f"claimed from an incomplete run")
+    if res_r["restarts"] < 1 or res_e["respawns"] < 1:
+        raise RuntimeError(
+            f"the seeded kill never fired (restarts="
+            f"{res_r['restarts']}, respawns={res_e['respawns']}) — "
+            f"the A/B would compare two undisturbed runs")
+    speedup = res_r["wall_seconds"] / res_e["wall_seconds"]
+    emit({
+        "metric": "ssgd_cluster_elastic_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": None,
+        "elastic_wall_s": res_e["wall_seconds"],
+        "restart_wall_s": res_r["wall_seconds"],
+        "elastic_final_acc": round(res_e["accuracy"], 6),
+        "restart_final_acc": round(res_r["accuracy"], 6),
+        "n_workers": CLUSTER_SLOTS, "n_windows": windows,
+        "staleness": s, "kill_window": kill_w,
+        "checkpoint_every": ce, "plan": plan,
+        "note": "wall clock, kill-one-worker mid-run: elastic "
+                "(continue at reduced quorum + rejoin from the "
+                "center) vs restart-policy baseline (abort + full "
+                "respawn from the checkpoint); thread-mode workers "
+                "in both arms, so the ratio isolates the policy",
+    })
+
+    cfg_p = clus.ClusterConfig(
+        n_slots=1, n_windows=8 if fast else 16, staleness=2,
+        heartbeat_timeout=3.0, train=task)
+    res_p = clus.run_local_cluster(cfg_p, spawn="thread",
+                                   timeout=120.0)
+    stats = (res_p["worker_stats"] or {}).get(0) or {}
+    p50 = stats.get("push_pull_ms_p50")
+    if not p50 or not stats.get("pushes"):
+        raise RuntimeError(
+            f"push/pull timing never reported (stats={stats}) — "
+            f"refusing to fabricate a latency")
+    emit({
+        "metric": "cluster_push_pull_ms",
+        "value": round(float(p50), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "pushes": stats["pushes"],
+        "mean_ms": round(stats["push_pull_ms_total"]
+                         / max(1, stats["pushes"]), 3),
+        "note": "median push->commit->pull round trip at the PS tier "
+                "(framed delta up, staleness-weighted merge, framed "
+                "center back) on an idle single-worker cluster — the "
+                "per-window transport+merge cost floor",
+    })
+
+
+def _bench_cluster(mesh, n_chips):
+    del mesh, n_chips  # the cluster builds its own local worker meshes
+    run_cluster_bench(_emit)
+
+
 def _bench_ssp(mesh, n_chips, sync="bsp"):
     """The SSP straggler phase — see
     :func:`run_ssp_straggler_speedup`. ``--sync ssp:s`` overrides the
@@ -2443,6 +2567,8 @@ ALL_METRIC_NAMES = (
     "ssgd_comm_topk_step_speedup",
     "ssgd_ssp_straggler_speedup",
     "ssgd_ssp_equal_loss_steps",
+    "ssgd_cluster_elastic_speedup",
+    "cluster_push_pull_ms",
     "ssgd_lr_100m_rows_steps_per_sec_per_chip",
     "ssgd_lr_1b_rows_virtual_steps_per_sec_per_chip",
     "ssgd_lr_32gb_streamed_steps_per_sec_per_chip",
@@ -2469,7 +2595,8 @@ ALL_METRIC_NAMES = (
 #: ratio): the regression tripwire flags these on a >15% RISE, and
 #: never flags an improvement
 LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",
-                                     "ssgd_ssp_equal_loss_steps"))
+                                     "ssgd_ssp_equal_loss_steps",
+                                     "cluster_push_pull_ms"))
 
 #: canonical units, for the skipped-with-zero lines
 _METRIC_UNITS = {
@@ -2486,6 +2613,8 @@ _METRIC_UNITS = {
     "ssgd_comm_topk_step_speedup": "x",
     "ssgd_ssp_straggler_speedup": "x",
     "ssgd_ssp_equal_loss_steps": "x",
+    "ssgd_cluster_elastic_speedup": "x",
+    "cluster_push_pull_ms": "ms",
     "ring_attention_32k_tokens_per_sec_per_chip": "tokens/s/chip",
     "ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip":
         "tokens/s/chip",
@@ -2786,6 +2915,9 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
             run_ssp_straggler_speedup, mesh, _cpu_emit,
             **(dict(steps=16, repeats=1, conv_iters=48)
                if fast else {})))
+    _phase_optional(
+        "cpu_cluster",
+        functools.partial(run_cluster_bench, _cpu_emit, fast=fast))
     _phase_optional("cpu_pagerank", cpu_pagerank)
     _phase_optional("cpu_pagerank_streamed", cpu_pagerank_streamed)
     _phase_optional(
@@ -2921,6 +3053,10 @@ def _run(args):
             # emitting a fabricated 0.0 ratio when SSP misses the band
             _phase_optional("ssp", _bench_ssp, mesh, n_chips,
                             args.sync)
+            # the multi-process elastic runtime: host processes by
+            # construction, so it runs (honestly) on every backend;
+            # raises rather than fabricating on an incomplete run
+            _phase_optional("cluster", _bench_cluster, mesh, n_chips)
             # optional, and BOTH raise instead of emitting fabricated
             # lines on failure (the serve-round-3 / ssp lesson): a
             # parity miss or a refused capacity is a recorded phase

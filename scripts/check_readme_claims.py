@@ -95,6 +95,15 @@ CLAIMS = [
     ("ssgd_ssp_equal_loss_steps",
      r"BSP-endpoint accuracy\s+within \*\*([\d.]+?)×\*\* the steps",
      1.0),
+    # multi-process elastic runtime (round 16): the kill-one-worker
+    # elastic-vs-restart wall-clock ratio is a FLOOR (host
+    # processes/threads by construction — honest on every backend);
+    # the PS push/pull round trip is a CEILING (lower is better)
+    ("ssgd_cluster_elastic_speedup",
+     r"kill-one-worker run \*\*([\d.]+?)×\+\*\* the BSP-restart "
+     r"baseline", 1.0),
+    ("cluster_push_pull_ms",
+     r"push/pull round trip under \*\*([\d.]+?)\s*ms\*\*", 1.0),
     # online serving layer (round 13): throughput claimed as a floor
     # and the scoring p99 as a CEILING until the first real-backend
     # round records the achieved numbers (cpu-tagged fallback lines
@@ -123,6 +132,7 @@ FLOOR_CLAIMS = frozenset((
     "pagerank_100m_iters_per_sec",
     "serve_als_qps",
     "ssgd_ssp_straggler_speedup",
+    "ssgd_cluster_elastic_speedup",
     "reshard_1gb_gbps",
     "ssgd_2d_mesh_step_speedup",
     "closure_10m_paths_per_sec",
@@ -134,6 +144,7 @@ FLOOR_CLAIMS = frozenset((
 CEILING_CLAIMS = frozenset((
     "serve_lr_p99_ms",
     "ssgd_ssp_equal_loss_steps",
+    "cluster_push_pull_ms",
 ))
 
 

@@ -1198,3 +1198,70 @@ def test_tda080_negative_engine_and_program_specs():
     """
     assert lint(clean, path=MODEL) == []
     assert lint(clean, path=SRV) == []
+
+
+# ---------------------------------------------------------------- TDA090
+
+CLUS = "tpu_distalg/cluster/somemod.py"
+
+
+def test_tda090_bare_recv_and_accept_flagged():
+    src = """
+    def serve(listener):
+        conn, _ = listener.accept()
+        return conn.recv(4096)
+    """
+    assert codes(lint(src, path=CLUS)) == ["TDA090", "TDA090"]
+    # scope: only tpu_distalg/cluster/
+    assert "TDA090" not in codes(lint(src, path=LIB))
+
+
+def test_tda090_settimeout_arms_the_scope():
+    src = """
+    def serve(listener, sock, remaining):
+        listener.settimeout(remaining)
+        conn, _ = listener.accept()
+        chunk = sock.recv(4096)
+        return conn, chunk
+    """
+    assert lint(src, path=CLUS) == []
+
+
+def test_tda090_settimeout_none_is_spelled_out_block_forever():
+    src = """
+    def serve(sock):
+        sock.settimeout(None)
+        return sock.recv(4)
+    """
+    got = codes(lint(src, path=CLUS))
+    assert got == ["TDA090", "TDA090"]  # the None AND the bare recv
+
+
+def test_tda090_unframed_sendall_flagged_framed_ok():
+    bad = """
+    def reply(sock, payload):
+        sock.sendall(b"raw bytes")
+        sock.sendall(payload)
+    """
+    assert codes(lint(bad, path=CLUS)) == ["TDA090", "TDA090"]
+    good = """
+    from tpu_distalg.cluster.transport import encode_frame
+
+    def reply(sock, kind, meta):
+        buf = encode_frame(kind, meta)
+        sock.sendall(buf)
+        sock.sendall(encode_frame("ack", {}))
+    """
+    assert lint(good, path=CLUS) == []
+
+
+def test_tda090_nested_scope_needs_its_own_deadline():
+    src = """
+    def outer(sock, remaining):
+        sock.settimeout(remaining)
+
+        def inner(other):
+            return other.recv(4)   # the outer deadline does not
+        return inner               #   cover this socket
+    """
+    assert codes(lint(src, path=CLUS)) == ["TDA090"]

@@ -1,0 +1,521 @@
+"""Multi-process elastic runtime (tpu_distalg/cluster/).
+
+Four layers of evidence, cheapest first: transport framing (round
+trip + the fuzz grid: truncated frame, oversized length, deadline
+expiry, CRC corruption, unsafe dtype), the PS tier's rule-table
+split/merge math, the plan-pure worker schedule compiler, and the
+LIVE cluster grid — thread-mode (same protocol, same sockets, fast)
+for kill/straggle/join/restart/replay determinism, and a real
+subprocess run (genuine ``kill -9`` + rejoin through the CLI) as the
+acceptance: reduced-quorum survival, final accuracy inside the SSP
+chaos band of the undisturbed run, and the same plan replaying to
+the identical merge/membership event digest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from tpu_distalg import cluster as clus
+from tpu_distalg import faults
+from tpu_distalg.cluster import ps as psmod
+from tpu_distalg.cluster import transport, worker
+from tpu_distalg.faults import registry as fregistry
+from tpu_distalg.faults.chaos import SSP_CHAOS_ACC_BAND
+
+
+# ------------------------------------------------------------ transport
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_transport_round_trip():
+    a, b = _pipe()
+    arrays = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "idx": np.array([3, 1, 2], np.int64),
+              "flag": np.array([True, False])}
+    transport.send_frame(a, "push", {"slot": 2, "window": 7}, arrays)
+    kind, meta, out = transport.recv_frame(b, deadline=5.0)
+    assert kind == "push" and meta == {"slot": 2, "window": 7}
+    for k, v in arrays.items():
+        assert out[k].dtype == v.dtype
+        assert np.array_equal(out[k], v)
+    a.close(), b.close()
+
+
+def test_transport_truncated_frame_is_closed_not_garbage():
+    a, b = _pipe()
+    buf = transport.encode_frame("x", {"n": 1}, {"w": np.ones(8)})
+    a.sendall(buf[: len(buf) - 5])
+    a.close()
+    with pytest.raises(transport.TransportClosed,
+                       match="truncated frame"):
+        transport.recv_frame(b, deadline=5.0)
+    b.close()
+
+
+def test_transport_oversized_length_refused_before_allocation():
+    a, b = _pipe()
+    buf = bytearray(transport.encode_frame("x", {}))
+    # forge a multi-GB body length into the prefix
+    import struct
+
+    magic, hlen, _, crc = transport._PREFIX.unpack(
+        bytes(buf[: transport._PREFIX.size]))
+    buf[: transport._PREFIX.size] = transport._PREFIX.pack(
+        magic, hlen, 1 << 40, crc)
+    a.sendall(bytes(buf))
+    with pytest.raises(transport.FrameTooLarge, match="max_frame"):
+        transport.recv_frame(b, deadline=5.0)
+    a.close(), b.close()
+
+
+def test_transport_deadline_expiry_is_timeout():
+    a, b = _pipe()
+    t0 = time.monotonic()
+    with pytest.raises(transport.TransportTimeout, match="deadline"):
+        transport.recv_frame(b, deadline=0.2)
+    assert time.monotonic() - t0 < 5.0
+    # and a PARTIAL frame followed by silence times out too (the
+    # partition-mid-message case)
+    buf = transport.encode_frame("x", {}, {"w": np.ones(4)})
+    a.sendall(buf[:6])
+    with pytest.raises(transport.TransportTimeout):
+        transport.recv_frame(b, deadline=0.2)
+    a.close(), b.close()
+
+
+def test_transport_crc_and_magic_detected():
+    a, b = _pipe()
+    buf = bytearray(transport.encode_frame("x", {"v": 1},
+                                           {"w": np.ones(4)}))
+    buf[-2] ^= 0xFF  # flip a body byte after the CRC was computed
+    a.sendall(bytes(buf))
+    with pytest.raises(transport.TransportError, match="CRC"):
+        transport.recv_frame(b, deadline=5.0)
+    a.close(), b.close()
+    a, b = _pipe()
+    a.sendall(b"HTTP/1.1 200 OK\r\n" + b"\x00" * 16)
+    with pytest.raises(transport.TransportError, match="magic"):
+        transport.recv_frame(b, deadline=5.0)
+    a.close(), b.close()
+
+
+def test_transport_object_dtype_refused_both_ends():
+    with pytest.raises(transport.TransportError, match="pickle"):
+        transport.encode_frame("x", {}, {"o": np.array([{}, []],
+                                                       dtype=object)})
+
+
+def test_transport_rpc_fault_seam():
+    faults.configure("seed=1;cluster:rpc@0=oserror")
+    try:
+        a, b = _pipe()
+        with pytest.raises(faults.InjectedOSError):
+            transport.send_frame(a, "x", {})
+        # next invocation passes (hit 0 consumed)
+        transport.send_frame(a, "x", {})
+        assert transport.recv_frame(b, deadline=5.0)[0] == "x"
+        a.close(), b.close()
+    finally:
+        faults.configure(False)
+
+
+# -------------------------------------------------------------- PS tier
+
+
+def test_ps_split_uneven_and_join_round_trip():
+    center = {"w": np.arange(31, dtype=np.float32)}
+    shards = psmod.split_center(center, "lr", 3)
+    # w is replicated P() in the lr table -> lives whole on shard 0
+    assert np.array_equal(shards[0]["w"], center["w"])
+    # a row-sharded leaf splits UNEVENLY via array_split (the
+    # cluster-shrink case the uneven reshard satellite covers device-
+    # side)
+    tree = {"res": np.arange(10 * 2, dtype=np.float32).reshape(10, 2)}
+    parts = psmod.split_center(tree, "lr", 3)
+    assert [p["res"].shape[0] for p in parts] == [4, 3, 3]
+    assert np.array_equal(psmod.join_center(parts)["res"],
+                          tree["res"])
+
+
+def test_ps_merge_is_staleness_weighted_mean():
+    center = {"w": np.zeros(4, np.float32)}
+    srv = psmod.ParameterServer(center, table="lr", n_shards=2,
+                                decay=0.5)
+    d0 = {"w": np.full(4, 1.0, np.float32)}
+    d1 = {"w": np.full(4, 3.0, np.float32)}
+    # commit window 4: slot 0 fresh (base 4, age 0, weight 1), slot 1
+    # two windows stale (base 2, age 2, weight 0.25)
+    recs = srv.merge(4, [(0, 4, d0), (1, 2, d1)])
+    assert [r["age"] for r in recs] == [0, 2]
+    want = (1.0 * 1.0 + 0.25 * 3.0) / 1.25
+    np.testing.assert_allclose(srv.snapshot()["w"],
+                               np.full(4, want, np.float32),
+                               rtol=1e-6)
+    assert srv.version == 5
+    # a commit nobody delivered to is a hard no-op
+    before = srv.snapshot()["w"].copy()
+    srv.merge(5, [])
+    assert np.array_equal(srv.snapshot()["w"], before)
+
+
+# ------------------------------------------------- schedules & registry
+
+
+def test_cluster_fault_points_pair_with_their_kinds_only():
+    fregistry.FaultRule("cluster:worker", "kill")
+    fregistry.FaultRule("cluster:worker", "straggle", arg=40.0)
+    fregistry.FaultRule("cluster:rpc", "oserror")
+    fregistry.FaultRule("cluster:rpc", "hang", arg=0.01)
+    with pytest.raises(ValueError, match="cluster:worker"):
+        fregistry.FaultRule("cluster:worker", "oserror")
+    with pytest.raises(ValueError, match="cluster:rpc"):
+        fregistry.FaultRule("cluster:rpc", "kill")
+
+
+def test_worker_schedule_plan_pure_and_codes():
+    plan = fregistry.FaultPlan.parse(
+        "seed=7;cluster:worker@10=kill;cluster:worker@22=straggle:40")
+    a = worker.compile_worker_schedule(10, 3, plan=plan)
+    b = worker.compile_worker_schedule(10, 3, plan=plan)
+    assert np.array_equal(a, b)
+    assert a[3, 1] == worker.KILL          # cell 10 = w3, slot 1
+    assert a[7, 1] == 40                   # cell 22 = w7, slot 1
+    assert (a != 0).sum() == 2
+    # no plan / no cluster rules -> all-zero schedule
+    assert not worker.compile_worker_schedule(4, 2, plan=None).any()
+
+
+def test_strip_kills_keeps_straggles():
+    spec = ("seed=7;cluster:worker@10=kill;"
+            "cluster:worker@22=straggle:40;ckpt:write@0=oserror")
+    out = fregistry.FaultPlan.parse(worker.strip_kills(spec))
+    kinds = sorted((r.point, r.kind) for r in out.rules)
+    assert kinds == [("ckpt:write", "oserror"),
+                     ("cluster:worker", "straggle")]
+    assert worker.strip_kills(None) is None
+
+
+# ------------------------------------------------ live cluster (thread)
+
+CFG = dict(n_slots=3, n_windows=8, staleness=3, heartbeat_timeout=3.0,
+           checkpoint_every=3,
+           train=clus.TrainTask(n_rows=1024, test_rows=512))
+
+
+def _run(plan=None, policy="elastic", n_slots=3, n_windows=8,
+         checkpoint_dir=None, **kw):
+    cfg = clus.ClusterConfig(**{
+        **CFG, "n_slots": n_slots, "n_windows": n_windows,
+        "plan_spec": plan, "policy": policy,
+        "checkpoint_dir": checkpoint_dir})
+    return clus.run_local_cluster(cfg, spawn="thread", timeout=180.0,
+                                  **kw)
+
+
+@pytest.fixture(scope="module")
+def undisturbed():
+    return _run()
+
+
+def test_cluster_undisturbed_completes_and_converges(undisturbed):
+    res = undisturbed
+    assert res["version"] == 8
+    # every merge carries all three slots at age 0, nothing skipped
+    for w, applied, skipped in res["merge_sequence"]:
+        assert applied == ((0, 0), (1, 0), (2, 0))
+        assert skipped == ()
+    assert res["membership_sequence"] == [
+        ("join", 0, 0), ("join", 1, 0), ("join", 2, 0)]
+    assert res["accuracy"] > 0.65
+    # worker stats reported through the bye frames
+    assert sorted(res["worker_stats"]) == [0, 1, 2]
+    assert all(s["pushes"] == 8 for s in res["worker_stats"].values())
+
+
+def test_cluster_kill_one_mid_window_and_rejoin(undisturbed):
+    # cell 10 = (window 3, slot 1) at 3 slots
+    res = _run(plan="seed=7;cluster:worker@10=kill", rejoin_after=2)
+    assert res["version"] == 8 and res["respawns"] == 1
+    mem = res["membership_sequence"]
+    assert ("leave", 1, 3) in mem          # died owing window 3
+    assert ("join", 1, 5) in mem           # pinned rejoin at 3+2
+    by_window = {w: applied for w, applied, _ in
+                 res["merge_sequence"]}
+    # reduced quorum through the absence, full strength after rejoin
+    assert by_window[3] == ((0, 0), (2, 0))
+    assert by_window[4] == ((0, 0), (2, 0))
+    assert by_window[5] == ((0, 0), (1, 0), (2, 0))
+    # the acceptance band: chaos endpoint within the SSP band of the
+    # undisturbed run
+    assert abs(res["accuracy"]
+               - undisturbed["accuracy"]) <= SSP_CHAOS_ACC_BAND
+
+
+def test_cluster_straggle_one_skips_then_delivers_staler():
+    # cell 13 = (window 4, slot 1): skip at 4, deliver at 5 aged
+    res = _run(plan="seed=7;cluster:worker@13=straggle:30")
+    assert res["version"] == 8
+    by_window = {w: (applied, skipped) for w, applied, skipped in
+                 res["merge_sequence"]}
+    assert by_window[4] == (((0, 0), (2, 0)), (1,))
+    applied5, _ = by_window[5]
+    assert (1, 1) in applied5              # age-1 delivery
+    assert res["worker_stats"][1]["skips"] == 1
+
+
+def test_cluster_same_plan_replays_identical_sequences():
+    plan = ("seed=7;cluster:worker@10=kill;"
+            "cluster:worker@22=straggle:30")
+    a = _run(plan=plan, rejoin_after=2)
+    b = _run(plan=plan, rejoin_after=2)
+    assert a["merge_sequence"] == b["merge_sequence"]
+    assert a["membership_sequence"] == b["membership_sequence"]
+    # the slot-ordered float merges make even the center bitwise
+    assert np.array_equal(a["center"]["w"], b["center"]["w"])
+
+
+def test_cluster_restart_policy_is_the_gang_scheduled_baseline(
+        tmp_path):
+    res = _run(plan="seed=7;cluster:worker@10=kill",
+               policy="restart", checkpoint_dir=str(tmp_path))
+    assert res["version"] == 8
+    assert res["restarts"] == 1
+    assert res["respawns"] == 0            # nobody rejoins: everyone respawns
+    assert res["accuracy"] > 0.65
+
+
+def test_cluster_join_one_late():
+    # spawn only 2 of 3 slots; the third joins mid-run, unsolicited
+    cfg = clus.ClusterConfig(**{**CFG, "n_windows": 10})
+    coord = clus.Coordinator(cfg).start()
+    try:
+        from tpu_distalg.cluster.local import _ThreadWorker
+
+        w0 = _ThreadWorker("127.0.0.1", coord.port, 0)
+        w1 = _ThreadWorker("127.0.0.1", coord.port, 1)
+        deadline = time.monotonic() + 60
+        while coord.version < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert coord.version >= 3
+        w2 = _ThreadWorker("127.0.0.1", coord.port, 2)
+        res = coord.wait(timeout=120.0)
+        for w in (w0, w1, w2):
+            w.join(timeout=30)
+    finally:
+        coord.stop()
+    assert res["version"] == 10
+    joins = [e for e in res["membership_sequence"]
+             if e[0] == "join"]
+    late = [e for e in joins if e[1] == 2]
+    assert late and late[0][2] >= 3        # admitted mid-run
+    # it participates in every window from its admission on
+    admit = late[0][2]
+    for w, applied, _ in res["merge_sequence"]:
+        slots = [s for s, _age in applied]
+        assert (2 in slots) == (w >= admit)
+
+
+def test_cluster_heartbeat_timeout_detects_partitioned_worker():
+    """A worker that goes silent WITHOUT closing its sockets (the
+    rpc-hang partition) is declared dead by the heartbeat scan and
+    the run completes at reduced quorum."""
+    cfg = clus.ClusterConfig(**{
+        **CFG, "n_slots": 2, "n_windows": 6,
+        "heartbeat_timeout": 1.0})
+    coord = clus.Coordinator(cfg).start()
+    try:
+        from tpu_distalg.cluster.local import _ThreadWorker
+
+        w0 = _ThreadWorker("127.0.0.1", coord.port, 0)
+        # slot 1: joins, pushes nothing, beats nothing — just a held
+        # socket (the partitioned peer)
+        sock = transport.connect("127.0.0.1", coord.port)
+        kind, meta, _ = transport.request(sock, "join", {"slot": 1})
+        assert kind == "welcome"
+        res = coord.wait(timeout=120.0)
+        w0.join(timeout=30)
+        sock.close()
+    finally:
+        coord.stop()
+    assert res["version"] == 6
+    assert ("leave", 1, 0) in res["membership_sequence"]
+
+
+def test_cluster_straggle_on_final_window_records_the_loss():
+    # cell 22 = (window 7, slot 1) at 8 windows: no later boundary
+    # exists for the delta to ride — the loss is RECORDED, not silent
+    res = _run(plan="seed=7;cluster:worker@22=straggle:30")
+    assert res["version"] == 8
+    _, skipped = {w: (a, sk) for w, a, sk in
+                  res["merge_sequence"]}[7]
+    assert skipped == (1,)
+    assert res["worker_stats"][1]["undelivered_windows"] == 1
+    assert res["worker_stats"][0]["undelivered_windows"] == 0
+
+
+def test_cluster_zombie_incarnation_is_fenced():
+    """A partitioned predecessor's late frames (and its connection's
+    eventual EOF) must neither act on nor kill the slot's healthy
+    replacement."""
+    cfg = clus.ClusterConfig(**{**CFG, "n_slots": 1, "n_windows": 4,
+                                "heartbeat_timeout": 30.0})
+    coord = clus.Coordinator(cfg).start()
+    try:
+        zombie = transport.connect("127.0.0.1", coord.port)
+        kind, meta, _ = transport.request(zombie, "join", {"slot": 0})
+        assert kind == "welcome"
+        old_inc = int(meta["incarnation"])
+        # the zombie partitions: declared dead via its connection EOF
+        zombie.close()
+        deadline = time.monotonic() + 30
+        while coord.slots[0].status == "active" and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        # replacement takes the slot with a fresh incarnation
+        repl = transport.connect("127.0.0.1", coord.port)
+        kind, meta2, _ = transport.request(repl, "join", {"slot": 0})
+        assert kind == "welcome"
+        assert int(meta2["incarnation"]) > old_inc
+        # the healed zombie's frames carry the OLD token: rejected,
+        # and its beats do not refresh the replacement's liveness
+        late = transport.connect("127.0.0.1", coord.port)
+        k, m, _ = transport.request(
+            late, "skip", {"slot": 0, "inc": old_inc, "window": 0})
+        assert k == "error" and "stale" in m["error"]
+        before = coord.slots[0].last_beat
+        transport.request(late, "beat", {"slot": 0, "inc": old_inc})
+        assert coord.slots[0].last_beat == before
+        # the zombie-tagged connections' EOFs never joined/bound here,
+        # and the fenced death check keeps the replacement alive
+        late.close()
+        time.sleep(0.2)
+        assert coord.slots[0].status == "active"
+        repl.close()
+    finally:
+        coord.stop()
+
+
+def test_cluster_rejects_bsp_and_bad_policy():
+    with pytest.raises(ValueError, match="policy"):
+        clus.ClusterConfig(policy="bsp")
+    with pytest.raises(ValueError, match="n_slots"):
+        clus.ClusterConfig(n_slots=0)
+
+
+def test_cluster_checkpoint_resume_rejects_foreign_tag(tmp_path):
+    from tpu_distalg.utils import checkpoint as ckpt
+
+    ckpt.save(str(tmp_path),
+              {"tag": ckpt.encode_tag("ssgd:bsp"),
+               "center": {"w": np.zeros(3, np.float32)}}, step=4)
+    with pytest.raises(ValueError, match="fresh directory"):
+        clus.Coordinator(clus.ClusterConfig(
+            **{**CFG, "checkpoint_dir": str(tmp_path)}))
+
+
+# --------------------------------------------- subprocess acceptance
+
+
+def _cli_cluster(tmp, plan, extra=()):
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TDA_TELEMETRY_DIR="",
+               TDA_FAULT_PLAN="")
+    cmd = [sys.executable, "-m", "tpu_distalg.cli", "cluster",
+           "--role", "local", "--spawn", "process", "--workers", "3",
+           "--n-windows", "8", "--sync", "ssp:3",
+           "--heartbeat-timeout", "3", "--n-rows", "1024",
+           "--deadline", "280", "--fault-plan", plan, *extra]
+    r = subprocess.run(cmd, env=env, cwd=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-2000:])
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("cluster_result: ")][-1]
+    return json.loads(line[len("cluster_result: "):])
+
+
+def test_subprocess_kill9_rejoin_and_replay(tmp_path):
+    """THE acceptance: a real 3-process cluster survives a genuine
+    seeded ``kill -9`` of one worker mid-window plus a late rejoin,
+    completes inside the SSP chaos band of the undisturbed run, and
+    the same plan replays to an identical merge/membership digest."""
+    plan = "seed=7;cluster:worker@13=kill"  # (window 4, slot 1)
+    undisturbed = _cli_cluster(tmp_path, "seed=7")
+    a = _cli_cluster(tmp_path, plan)
+    b = _cli_cluster(tmp_path, plan)
+    assert a["version"] == 8 and a["merges"] == 8
+    assert a["respawns"] == 1
+    assert a["event_digest"] == b["event_digest"]
+    assert a["accuracy"] == b["accuracy"]
+    assert abs(a["accuracy"]
+               - undisturbed["accuracy"]) <= SSP_CHAOS_ACC_BAND
+    assert undisturbed["respawns"] == 0
+
+
+@pytest.mark.slow
+def test_subprocess_grid_straggle_and_rpc_partition(tmp_path):
+    """The wider spawn-heavy grid: straggle-one and an rpc hang (a
+    transient partition the transport deadline + heartbeat machinery
+    must ride out), each replayed."""
+    for plan in ("seed=7;cluster:worker@13=straggle:40",
+                 "seed=7;cluster:rpc@p0.02=hang:0.2"):
+        a = _cli_cluster(tmp_path, plan)
+        b = _cli_cluster(tmp_path, plan)
+        assert a["version"] == 8
+        assert a["event_digest"] == b["event_digest"]
+
+
+# ----------------------------------------------------- bench contract
+
+
+def test_cluster_bench_fast_mode_emits_both_metrics():
+    import bench
+
+    lines = []
+    bench.run_cluster_bench(lines.append, fast=True)
+    by = {ln["metric"]: ln for ln in lines}
+    assert set(by) == {"ssgd_cluster_elastic_speedup",
+                       "cluster_push_pull_ms"}
+    assert by["ssgd_cluster_elastic_speedup"]["value"] > 0
+    assert by["cluster_push_pull_ms"]["value"] > 0
+    assert by["ssgd_cluster_elastic_speedup"]["elastic_final_acc"] > .6
+
+
+def test_cluster_metrics_registered_for_claims_and_fallback():
+    import bench
+
+    for name in ("ssgd_cluster_elastic_speedup",
+                 "cluster_push_pull_ms"):
+        assert name in bench.ALL_METRIC_NAMES
+    assert "cluster_push_pull_ms" in bench.LOWER_IS_BETTER_METRICS
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import check_readme_claims as crc
+
+    claimed = {m for m, _, _ in crc.CLAIMS}
+    assert {"ssgd_cluster_elastic_speedup",
+            "cluster_push_pull_ms"} <= claimed
+    assert "ssgd_cluster_elastic_speedup" in crc.FLOOR_CLAIMS
+    assert "cluster_push_pull_ms" in crc.CEILING_CLAIMS
+    readme = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "README.md")
+    with open(readme) as f:
+        claims = crc.extract_claims(f.read())
+    assert "ssgd_cluster_elastic_speedup" in claims
+    assert "cluster_push_pull_ms" in claims

@@ -518,15 +518,103 @@ def test_local_sgd_ssp_converges_within_band(mesh4, cancer_data):
 
 # --------------------------------------------------- rejection guards
 
-def test_ssp_rejects_fused_samplers(mesh4, cancer_data):
-    with pytest.raises(ValueError, match="bernoulli"):
+def test_ssp_rejects_megakernel_and_fixed_samplers(mesh4,
+                                                   cancer_data):
+    # PR 9's fused_gather rejection is LIFTED (the fused-SSP tests
+    # below); the megakernel (no per-window collective inside a
+    # launch) and the legacy 'fixed' gather path stay BSP, as does
+    # the local_sgd family's fused path
+    with pytest.raises(ValueError, match="fused_train"):
         ssgd.train(*cancer_data, mesh4,
                    ssgd.SSGDConfig(n_iterations=8, sync="ssp:4",
-                                   sampler="fused_gather"))
+                                   sampler="fused_train"))
+    with pytest.raises(ValueError, match="stale-synchronous"):
+        ssgd.train(*cancer_data, mesh4,
+                   ssgd.SSGDConfig(n_iterations=8, sync="ssp:4",
+                                   sampler="fixed"))
     with pytest.raises(ValueError, match="bernoulli"):
         bmuf.train(*cancer_data, mesh4,
                    bmuf.BMUFConfig(n_iterations=8, sync="ssp:4",
                                    sampler="fused_gather"))
+
+
+# ------------------------------------------- fused-kernel sampler SSP
+
+def _fused_task(n=4096, test=512):
+    from tpu_distalg.utils import datasets
+
+    X, y = datasets.synthetic_two_class(n + test, 30, seed=0)
+    X = datasets.add_bias_column(X)
+    return X[:n], y[:n], X[n:], y[n:]
+
+
+FUSED_KW = dict(sampler="fused_gather", gather_block_rows=128,
+                eval_every=1)
+
+
+def test_ssp_fused_gather_s1_bsp_parity(mesh1):
+    """The s=1 parity pin: one shard, one-tick windows, decay 1 — the
+    SSP window algebra degenerates to the BSP update. The ACCURACY
+    trajectory is bitwise the BSP fused trainer's; the weights agree
+    to a few ulps (measured <= 7 over 24 windows; bound 8 here) — exact bitwise
+    equality is structurally out of reach because SSP must MATERIALIZE
+    the shipped delta while XLA contracts BSP's subtract-of-product
+    into a single-rounding FMA (the bernoulli path exhibits the
+    identical bound, asserted alongside so the property cannot
+    silently rot into something looser)."""
+    task = _fused_task()
+
+    def ulp_ok(a, b, ulps=8):
+        a, b = np.asarray(a), np.asarray(b)
+        return bool(np.all(
+            np.abs(a - b)
+            <= ulps * np.spacing(np.maximum(np.abs(a), np.abs(b)))))
+
+    for kw in (FUSED_KW, {}):          # fused_gather AND bernoulli
+        cfg = dict(n_iterations=24, eval_every=1, **{
+            k: v for k, v in kw.items() if k != "eval_every"})
+        bsp = ssgd.train(*task, mesh1,
+                         ssgd.SSGDConfig(**cfg, sync="bsp"))
+        s1 = ssgd.train(*task, mesh1,
+                        ssgd.SSGDConfig(**cfg, sync="ssp:1:1.0"))
+        assert np.asarray(bsp.accs).tobytes() == \
+            np.asarray(s1.accs).tobytes(), kw
+        assert ulp_ok(bsp.w, s1.w), kw
+
+
+def test_ssp_fused_gather_replays_bitwise_under_straggle_plan(mesh4):
+    task = _fused_task()
+    faults.configure(STRAGGLE_PLAN)
+    cfg = ssgd.SSGDConfig(n_iterations=48, sync="ssp:4", **FUSED_KW)
+    a = ssgd.train(*task, mesh4, cfg)
+    faults.configure(STRAGGLE_PLAN)
+    b = ssgd.train(*task, mesh4, cfg)
+    assert np.asarray(a.w).tobytes() == np.asarray(b.w).tobytes()
+    assert np.asarray(a.accs).tobytes() == \
+        np.asarray(b.accs).tobytes()
+
+
+def test_ssp_fused_gather_converges_and_resumes_bitwise(mesh4,
+                                                        tmp_path):
+    task = _fused_task()
+    cfg = ssgd.SSGDConfig(n_iterations=240, sync="ssp:4", **FUSED_KW)
+    straight = ssgd.train(*task, mesh4, cfg)
+    seg = ssgd.train(*task, mesh4, cfg,
+                     checkpoint_dir=str(tmp_path),
+                     checkpoint_every=80)
+    assert np.asarray(straight.w).tobytes() == \
+        np.asarray(seg.w).tobytes()
+    bsp = ssgd.train(
+        *task, mesh4,
+        ssgd.SSGDConfig(n_iterations=240, **FUSED_KW))
+    assert abs(straight.final_acc - bsp.final_acc) < 0.1
+    # a resume under the BERNOULLI ssp tag must reject: the augmented
+    # weight layout is not the XLA path's
+    with pytest.raises(ValueError, match="fresh directory"):
+        ssgd.train(*task, mesh4,
+                   ssgd.SSGDConfig(n_iterations=240, sync="ssp:4"),
+                   checkpoint_dir=str(tmp_path),
+                   checkpoint_every=80)
 
 
 def test_cli_sync_flag_threads_through(cancer_data):

@@ -372,6 +372,69 @@ def test_report_summarize_and_render(sink_dir, capsys):
     assert parsed["metrics"]["m1"]["unit"] == "u"
 
 
+def _make_worker_dir(root, name, counters):
+    events.configure(os.path.join(root, name))
+    for k, v in counters.items():
+        events.counter(k, v)
+    events.mark(name)
+    events.configure(False)  # close -> flush the counters event
+
+
+def test_report_merges_multiple_dirs_with_per_worker_columns(
+        tmp_path, capsys):
+    """The cluster-runtime satellite: several --telemetry-dirs (or a
+    parent of per-worker dirs) render ONE merged report with
+    per-worker columns for the ssp.*/cluster.* counters."""
+    root = str(tmp_path / "cluster")
+    _make_worker_dir(root, "coordinator",
+                     {"cluster.merges": 8, "cluster.joins": 3})
+    _make_worker_dir(root, "worker-0",
+                     {"cluster.pushes": 8, "ssp.merges": 8})
+    _make_worker_dir(root, "worker-1",
+                     {"cluster.pushes": 6, "cluster.skips": 2,
+                      "ssp.merges": 6, "other.counter": 5})
+    # a parent dir expands to its event-bearing children
+    assert [os.path.basename(p)
+            for p in report.expand_dirs([root])] == [
+        "coordinator", "worker-0", "worker-1"]
+    rc = report.report_main(root)
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "merged over 3 telemetry dir(s)" in text
+    assert "per-worker counters (ssp.*/cluster.*):" in text
+    # merged totals sum across processes
+    assert "cluster.pushes=14" in text
+    # column table: worker-1's skips present, worker-0's blank
+    row = [ln for ln in text.splitlines()
+           if ln.strip().startswith("cluster.skips")][0]
+    cols = row.split()
+    assert cols[-1] == "2" and cols[-2] == "-"
+    # non-prefixed counters stay out of the column table
+    assert not any(ln.strip().startswith("other.counter")
+                   for ln in text.splitlines()
+                   if ln.startswith("  other"))
+    # explicit multiple dirs work the same way; single dir renders the
+    # classic report (no merge header)
+    rc = report.report_main([os.path.join(root, "worker-0"),
+                             os.path.join(root, "worker-1")])
+    assert rc == 0
+    assert "merged over 2" in capsys.readouterr().out
+    rc = report.report_main(os.path.join(root, "worker-0"))
+    assert "merged over" not in capsys.readouterr().out
+
+
+def test_report_multi_json_mode(tmp_path, capsys):
+    root = str(tmp_path / "c")
+    _make_worker_dir(root, "worker-0", {"cluster.pushes": 1})
+    _make_worker_dir(root, "worker-1", {"cluster.pushes": 2})
+    report.report_main(root, as_json=True)
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"merged", "workers"}
+    assert doc["merged"]["counters"]["cluster.pushes"] == 3
+    assert doc["workers"]["worker-1"]["counters"][
+        "cluster.pushes"] == 2
+
+
 def test_report_tolerates_torn_tail_line(tmp_path):
     d = str(tmp_path)
     p = os.path.join(d, "events-abc.jsonl")

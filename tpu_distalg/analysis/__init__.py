@@ -41,6 +41,13 @@ TDA080      no raw ``NamedSharding``/placement-spec construction or
             ``tpu_distalg/models/`` / ``tpu_distalg/serve/`` — every
             placement routes through the partition-rule engine
             (``parallel/partition.py`` rule tables, PR 11)
+TDA090      cluster transport discipline in ``tpu_distalg/cluster/``:
+            no blocking socket receive/accept without a deadline
+            armed in scope (a partition must surface as
+            ``TransportTimeout``, never a wedged thread) and no
+            ``sendall`` of a payload the frame encoder did not build
+            (an unframed write desynchronizes the length-prefixed
+            stream)
 ==========  =========================================================
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
@@ -50,6 +57,7 @@ Run via ``tda lint [paths] [--format json] [--baseline FILE]
 """
 
 from tpu_distalg.analysis import baseline
+from tpu_distalg.analysis.cluster import RULES as _CLUSTER
 from tpu_distalg.analysis.comms import RULES as _COMMS
 from tpu_distalg.analysis.concurrency import RULES as _CONCURRENCY
 from tpu_distalg.analysis.determinism import RULES as _DETERMINISM
@@ -70,7 +78,7 @@ from tpu_distalg.analysis.tracing import RULES as _TRACING
 #: every shipped rule, in code order
 RULES = tuple(sorted(
     _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
-    + _SERVE + _SSP + _PARTITION,
+    + _SERVE + _SSP + _PARTITION + _CLUSTER,
     key=lambda r: r.code))
 
 __all__ = [

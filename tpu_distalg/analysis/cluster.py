@@ -1,0 +1,180 @@
+"""Cluster transport discipline (TDA090).
+
+The multi-process runtime's availability and safety contract is
+structural, like the serving layer's (TDA060): every blocking socket
+receive in ``tpu_distalg/cluster/`` is DEADLINE-BOUNDED (a partitioned
+peer must surface as :class:`~tpu_distalg.cluster.transport.
+TransportTimeout`, never wedge a coordinator thread forever), and
+every payload that hits the wire is LENGTH-PREFIX FRAMED through the
+transport's encoder (an unframed ``sendall`` desynchronizes the
+stream — the receiver reads the bytes as a length prefix and either
+allocates garbage or wedges; it is also how pickle-shaped ad-hoc
+payloads would sneak in). One forgotten bare ``recv()`` or raw
+``sendall(b"...")`` silently voids both; TDA090 makes the convention
+machine-checked.
+
+Flagged shapes::
+
+    conn, _ = listener.accept()        # no settimeout in scope
+    data = sock.recv(4096)             # no settimeout in scope
+    sock.settimeout(None)              # spelled-out block-forever
+    sock.sendall(b"hello")             # unframed payload
+    sock.sendall(payload)              # payload not built by a
+                                       #   frame encoder in scope
+
+Fine::
+
+    sock.settimeout(remaining)         # then recv/accept in the same
+    chunk = sock.recv(n)               #   function: deadline-bounded
+    buf = encode_frame(kind, meta)     # framed, then sent
+    sock.sendall(buf)
+    sock.sendall(encode_frame(...))    # framed inline
+
+The deadline check is function-scoped: a ``.settimeout(x)`` call with
+a non-``None`` argument anywhere in the SAME function body arms every
+receive in it (the transport's ``_recv_exact`` shape — recompute the
+remaining budget, set it, read). ``settimeout(None)`` does not count:
+that is the spelled-out block-forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_distalg.analysis.engine import Rule, call_name
+
+_RECV_METHODS = ("recv", "recvfrom", "recv_into", "recvmsg")
+
+
+def _attr_method(call: ast.Call) -> str | None:
+    """The trailing attribute name of a method-style call
+    (``x.y.recv(...)`` -> ``'recv'``), else None."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _direct_calls(fn: ast.AST):
+    """Calls belonging DIRECTLY to ``fn`` — nested function bodies are
+    excluded (they are checked as their own scope, with their own
+    settimeout evidence)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_deadline(fn: ast.AST) -> bool:
+    """True when the function arms a non-None socket timeout."""
+    for call in _direct_calls(fn):
+        if _attr_method(call) != "settimeout":
+            continue
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is None:
+            continue  # settimeout(None): the spelled-out block-forever
+        if call.args or call.keywords:
+            return True
+    return False
+
+
+def _frame_names(tree: ast.AST) -> set[str]:
+    """Names that produce framed bytes: anything imported from or
+    defined as a ``*frame*`` encoder (``encode_frame`` is the
+    transport's; a sibling module may alias it)."""
+    names = {"encode_frame"}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and "frame" in node.name and node.name.startswith(
+                    ("encode", "frame", "make", "build")):
+            names.add(node.name)
+    return names
+
+
+def _is_framed(arg, framed_vars: set[str], frame_fns: set[str]) -> bool:
+    if isinstance(arg, ast.Call):
+        name = call_name(arg)
+        return bool(name) and (
+            name.split(".")[-1] in frame_fns
+            or "frame" in name.split(".")[-1])
+    if isinstance(arg, ast.Name):
+        return arg.id in framed_vars
+    return False
+
+
+class ClusterTransportDiscipline(Rule):
+    code = "TDA090"
+    name = ("unbounded socket receive / unframed sendall in "
+            "cluster/")
+    invariant = (
+        "the cluster runtime stays live and speaks one wire format: "
+        "every blocking socket receive is deadline-bounded (a "
+        "partition surfaces as TransportTimeout, never a wedged "
+        "thread) and every sendall payload is length-prefix framed "
+        "by the transport encoder (an unframed write desynchronizes "
+        "the stream)")
+
+    def applies(self, ctx):
+        return "tpu_distalg/cluster/" in ctx.path
+
+    def check(self, ctx):
+        frame_fns = _frame_names(ctx.tree)
+        scopes = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+        for fn in scopes:
+            yield from self._check_scope(ctx, fn, frame_fns)
+
+    def _check_scope(self, ctx, fn, frame_fns):
+        has_deadline = _has_deadline(fn)
+        # variables assigned from a frame encoder in this scope are
+        # framed payloads (buf = encode_frame(...); sock.sendall(buf))
+        framed_vars: set[str] = set()
+        for call in _direct_calls(fn):
+            method = _attr_method(call)
+            if method == "settimeout" and call.args and \
+                    isinstance(call.args[0], ast.Constant) and \
+                    call.args[0].value is None and not has_deadline:
+                yield self.violation(
+                    ctx, call,
+                    "settimeout(None) is the spelled-out block-"
+                    "forever — every blocking receive in cluster/ "
+                    "must carry a real deadline (TransportTimeout is "
+                    "the partition observable)")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_framed(node.value, framed_vars, frame_fns):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        framed_vars.add(tgt.id)
+        for call in _direct_calls(fn):
+            method = _attr_method(call)
+            if method in _RECV_METHODS or method == "accept":
+                if not has_deadline:
+                    yield self.violation(
+                        ctx, call,
+                        f".{method}() with no socket timeout armed in "
+                        f"this function — a dead or partitioned peer "
+                        f"wedges this thread forever; call "
+                        f".settimeout(<remaining deadline>) before "
+                        f"blocking (transport._recv_exact is the "
+                        f"shape)")
+            elif method == "sendall":
+                if not call.args or not _is_framed(
+                        call.args[0], framed_vars, frame_fns):
+                    yield self.violation(
+                        ctx, call,
+                        "sendall of a payload not built by the frame "
+                        "encoder — an unframed write desynchronizes "
+                        "the length-prefixed stream (and is how "
+                        "ad-hoc pickle-shaped payloads sneak in); "
+                        "route it through transport.encode_frame / "
+                        "send_frame")
+
+
+RULES = (ClusterTransportDiscipline(),)

@@ -435,6 +435,83 @@ def main(argv=None):
                    help="closed-loop worker count")
     _add_telemetry(p)
 
+    p = sub.add_parser(
+        "cluster",
+        help="multi-process elastic runtime (tpu_distalg/cluster/): a "
+             "coordinator process plus N worker processes exchanging "
+             "staleness-weighted deltas with a parameter-server tier "
+             "over a framed TCP transport — kill -9 a worker mid-"
+             "window and training continues at reduced quorum; a "
+             "fresh worker rejoins by pulling the center")
+    p.add_argument("--role", default="local",
+                   choices=["coordinator", "worker", "local"],
+                   help="coordinator = serve rendezvous/clock/PS on "
+                        "--host:--port; worker = join a coordinator at "
+                        "--connect; local = spawn a coordinator plus "
+                        "--workers N workers on this machine (the "
+                        "test/bench mode)")
+    p.add_argument("--workers", type=int, default=3,
+                   help="worker slot count (coordinator/local roles)")
+    p.add_argument("--spawn", default="process",
+                   choices=["process", "thread"],
+                   help="local role: real worker processes (kill -9 is "
+                        "the genuine article) or threads (same "
+                        "protocol/sockets, fast for tests)")
+    p.add_argument("--connect", type=str, default=None,
+                   metavar="HOST:PORT",
+                   help="worker role: the coordinator's address")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="coordinator bind port (0 = ephemeral, "
+                        "printed at start)")
+    p.add_argument("--slot", type=int, default=None,
+                   help="worker role: requested slot (default: any "
+                        "free)")
+    p.add_argument("--rejoin", action="store_true",
+                   help="worker role: this is a replacement for a "
+                        "departed slot")
+    p.add_argument("--admit-at", type=int, default=None,
+                   help="worker role: pin admission to this window "
+                        "(the launcher's replay-determinism hook)")
+    p.add_argument("--n-windows", type=int, default=24,
+                   help="merge windows to train (each = s local ticks "
+                        "per worker)")
+    p.add_argument("--sync", default="ssp:4", metavar="MODE",
+                   help="staleness discipline ssp[:s[:decay]] — the "
+                        "cluster is stale-synchronous by construction "
+                        "(parallel/ssp.py semantics over the wire); "
+                        "s = ticks per window AND the clock gate's "
+                        "bound, decay = the PS merge weight decay^age")
+    p.add_argument("--algo", default="ssgd",
+                   choices=["ssgd", "local_sgd"],
+                   help="the existing trainer each worker wraps "
+                        "between push/pull seams")
+    p.add_argument("--ps-shards", type=int, default=2,
+                   help="parameter-server tier width: the center is "
+                        "split across this many PS shards per the "
+                        "model's partition rule table (uneven splits "
+                        "are first-class)")
+    p.add_argument("--policy", default="elastic",
+                   choices=["elastic", "restart"],
+                   help="death handling: elastic = continue at "
+                        "reduced quorum + rejoin; restart = abort and "
+                        "respawn everything from the checkpoint (the "
+                        "measured BSP-restart baseline)")
+    p.add_argument("--rejoin-after", type=int, default=3,
+                   help="local elastic role: windows a killed slot "
+                        "stays away before its replacement is "
+                        "admitted")
+    p.add_argument("--heartbeat-timeout", type=float, default=5.0,
+                   help="seconds of worker silence before the "
+                        "coordinator declares it dead (EOF on its "
+                        "connection is detected immediately)")
+    p.add_argument("--n-rows", type=int, default=4096,
+                   help="training rows of the shared synthetic task")
+    p.add_argument("--deadline", type=float, default=600.0,
+                   help="local/coordinator roles: give up if the run "
+                        "is still incomplete after this many seconds")
+    _add_ckpt(p, 8)
+
     p = sub.add_parser("mc", help="Monte-Carlo pi")
     p.add_argument("--n-slices", type=int, default=0)
     _add_mesh_shape(p)
@@ -483,9 +560,16 @@ def main(argv=None):
     p = sub.add_parser("report",
                        help="summarize a telemetry event log: phase "
                             "durations, stalls, backend-init attempts, "
-                            "restarts, last heartbeat, metrics")
-    p.add_argument("dir", help="telemetry directory (of events-*.jsonl) "
-                               "or one event file")
+                            "restarts, last heartbeat, metrics; "
+                            "several dirs (or a parent of per-worker "
+                            "dirs, e.g. a 'tda cluster' telemetry "
+                            "root) render ONE merged report with "
+                            "per-worker columns for the ssp.*/"
+                            "cluster.* counters")
+    p.add_argument("dir", nargs="+",
+                   help="telemetry directory (of events-*.jsonl), one "
+                        "event file, a parent directory of per-worker "
+                        "telemetry dirs, or several of these")
     p.add_argument("--json", action="store_true",
                    help="print the full summary as JSON (for CI)")
 
@@ -513,7 +597,17 @@ def main(argv=None):
 
     from tpu_distalg import faults, telemetry
 
-    telemetry.configure(getattr(args, "telemetry_dir", None))
+    tdir = getattr(args, "telemetry_dir", None)
+    if args.cmd == "cluster" and args.role == "local" and tdir:
+        # per-process telemetry layout: the coordinator's events land
+        # under DIR/coordinator, each spawned worker's under
+        # DIR/worker-N — 'tda report DIR' merges them with per-worker
+        # columns (configured here so no stray root event file is
+        # left behind)
+        import os as _os
+
+        tdir = _os.path.join(tdir, "coordinator")
+    telemetry.configure(tdir)
     if args.cmd != "chaos":
         # the chaos harness owns the registry lifecycle itself (it runs
         # an undisturbed reference first); everywhere else the plan is
@@ -579,7 +673,78 @@ def main(argv=None):
             hb.stop()
 
 
+def _run_cluster(args):
+    """``tda cluster`` — the multi-process elastic runtime."""
+    import hashlib
+    import json as _json
+    import os
+
+    from tpu_distalg import cluster as clus
+    from tpu_distalg import telemetry
+    from tpu_distalg.parallel import ssp as pssp
+
+    spec = pssp.SyncSpec.parse(args.sync)
+    if not spec.is_ssp:
+        raise SystemExit(
+            "the cluster runtime is stale-synchronous by construction "
+            "— --sync ssp[:s[:decay]] (a BSP cluster is the restart-"
+            "policy baseline the bench measures, not a mode)")
+    err = lambda m: print(m, file=sys.stderr)  # noqa: E731
+    if args.role == "worker":
+        if not args.connect:
+            raise SystemExit("--role worker needs --connect HOST:PORT")
+        host, _, port = args.connect.rpartition(":")
+        stats = clus.run_worker(
+            host or "127.0.0.1", int(port), slot=args.slot,
+            rejoin=args.rejoin, admit_at=args.admit_at, logger=err)
+        print("cluster_worker: " + _json.dumps(
+            {k: v for k, v in stats.items()
+             if not isinstance(v, list)}))
+        return 0
+    plan = args.fault_plan or os.environ.get("TDA_FAULT_PLAN") or None
+    cfg = clus.ClusterConfig(
+        n_slots=args.workers, n_windows=args.n_windows,
+        staleness=spec.staleness, decay=spec.decay,
+        ps_shards=args.ps_shards, host=args.host, port=args.port,
+        heartbeat_timeout=args.heartbeat_timeout,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        policy=args.policy, plan_spec=plan,
+        train=clus.TrainTask(algo=args.algo, n_rows=args.n_rows))
+    if args.role == "coordinator":
+        coord = clus.Coordinator(cfg).start()
+        print(f"cluster_coordinator: listening on "
+              f"{cfg.host}:{coord.port}", flush=True)
+        res = coord.wait(timeout=args.deadline)
+        coord.stop()
+    else:
+        # (main() already pointed this process's telemetry at
+        # DIR/coordinator; spawned workers get DIR/worker-N)
+        res = clus.run_local_cluster(
+            cfg, spawn=args.spawn, rejoin_after=args.rejoin_after,
+            telemetry_dir=args.telemetry_dir, timeout=args.deadline,
+            logger=err)
+    seq = _json.dumps(
+        [res["merge_sequence"], res["membership_sequence"]],
+        default=int)
+    # machine-readable tail line: the replay acceptance compares the
+    # event digest of two runs under the same plan
+    print("cluster_result: " + _json.dumps({
+        "accuracy": round(res["accuracy"], 6),
+        "version": res["version"],
+        "gen": res["gen"],
+        "merges": len(res["merge_sequence"]),
+        "respawns": res.get("respawns", 0),
+        "restarts": res.get("restarts", 0),
+        "event_digest":
+            hashlib.sha256(seq.encode()).hexdigest()[:16],
+    }))
+    return 0
+
+
 def _dispatch(args, jax):
+    if args.cmd == "cluster":
+        return _run_cluster(args)
     if args.cmd in ("lr", "ssgd", "ma", "bmuf", "easgd"):
         from tpu_distalg.utils import datasets
 

@@ -1,0 +1,47 @@
+"""Multi-process elastic runtime — coordinator/worker over TCP.
+
+The native replacement for PySpark's driver↔executor PROCESS model
+(SURVEY.md §4's last unported layer): a coordinator process owning
+rendezvous, the cross-process SSP clock, heartbeat failure detection
+and durable center checkpoints; N worker processes each running the
+existing SGD-family trainers on their own local mesh; and a
+parameter-server tier applying staleness-weighted (``decay**age``)
+delta merges — all over a length-prefixed framed-numpy TCP transport
+(no pickle, a deadline on every blocking receive; TDA090 lints the
+discipline). A worker can genuinely die (``kill -9``), lag, join and
+leave while training continues at reduced quorum; the seeded fault
+plan (``cluster:worker`` / ``cluster:rpc`` points) makes a chaos run
+replay to the identical merge/membership event sequence.
+
+See ``docs/ARCHITECTURE.md`` ("Multi-process elastic runtime") and
+``tda cluster --help``.
+"""
+
+from tpu_distalg.cluster import ps, transport
+from tpu_distalg.cluster.coordinator import (
+    ClusterAborted,
+    ClusterConfig,
+    Coordinator,
+    TrainTask,
+    center_accuracy,
+)
+from tpu_distalg.cluster.local import run_local_cluster
+from tpu_distalg.cluster.worker import (
+    compile_worker_schedule,
+    run_worker,
+    strip_kills,
+)
+
+__all__ = [
+    "ClusterAborted",
+    "ClusterConfig",
+    "Coordinator",
+    "TrainTask",
+    "center_accuracy",
+    "compile_worker_schedule",
+    "ps",
+    "run_local_cluster",
+    "run_worker",
+    "strip_kills",
+    "transport",
+]

@@ -1,0 +1,681 @@
+"""Coordinator process — rendezvous, clock, failure detection, commits.
+
+The multi-process replacement for PySpark's driver↔executor process
+model (the one reference layer PR 9 left in-process): one coordinator
+owns generation-numbered MEMBERSHIP (``parallel/membership.py``'s
+epoch semantics over the wire — every join/leave bumps the generation
+and is recorded as a ``membership_epoch`` event), the cross-process
+SSP CLOCK (the ``version`` counter: windows merged into the center —
+``parallel/ssp.py``'s clock vector collapsed to the one number the
+PS tier measures staleness against), HEARTBEAT failure detection
+(``telemetry/heartbeat.py`` threads on the worker side, an age scan
+here; a ``kill -9`` is seen even sooner as the connection's EOF), and
+DURABLE center checkpoints (``utils/checkpoint.py`` — CRC footer,
+atomic rename, quarantine fallback on resume).
+
+Determinism contract (the acceptance the chaos/replay tests pin):
+window ``w`` COMMITS only when every active admitted worker has
+delivered a push or announced a skip for ``w`` — and because workers
+pre-announce schedule-driven skips at window START, a straggler never
+stalls a commit (its interference overlaps the peers' windows; its
+delta arrives later, staler, weighted ``decay**age`` by the PS).
+Contributions apply in SLOT order, never arrival order, and a push's
+reply (the pull: the post-commit center) is deferred until its window
+commits — so the merge sequence, the applied weights, and the
+membership transitions are a pure function of the seeded fault plan,
+and the same plan replays to the identical event sequence. What stays
+timing-dependent is only WALL CLOCK (and the window at which an
+unsolicited late joiner is admitted — the local launcher pins that
+with an admission hold when replay equality matters).
+
+A worker's death (EOF or heartbeat-timeout) removes it from the
+expected set of the commit that was waiting on it, so training
+CONTINUES at reduced quorum; a fresh worker joins by pulling the
+center — no restart-budget burn, no resume-renegotiation round trip.
+``policy='restart'`` is the measured BSP-baseline alternative: any
+death aborts the run (checkpoint saved) for the launcher to respawn
+everything — the gang-scheduled world the elastic runtime replaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+
+import numpy as np
+
+from tpu_distalg.cluster import ps as psmod
+from tpu_distalg.cluster import transport
+from tpu_distalg.parallel import membership
+from tpu_distalg.parallel.ssp import (
+    DEFAULT_DECAY,
+    DEFAULT_STALENESS,
+)
+from tpu_distalg.telemetry import events as tevents
+
+#: how often the accept loop wakes to scan for stale heartbeats
+POLL_SECONDS = 0.05
+#: default worker-silence deadline before a slot is declared dead
+DEFAULT_HEARTBEAT_TIMEOUT = 5.0
+
+FREE, ACTIVE, DEAD = "free", "active", "dead"
+
+
+@dataclasses.dataclass
+class TrainTask:
+    """The training job the coordinator OWNS and hands every worker at
+    join (a worker needs only the coordinator's address): the synthetic
+    two-class task of bench.comm_comparison_task's shape, sliced into
+    per-slot contiguous row blocks."""
+
+    algo: str = "ssgd"            # 'ssgd' | 'local_sgd'
+    n_rows: int = 4096
+    test_rows: int = 1024
+    n_features: int = 30
+    data_seed: int = 0
+    seed: int = 42                # sampling seed base (per-slot stride)
+    eta: float = 0.1
+    mini_batch_fraction: float = 0.1
+    lam: float = 0.0
+    reg_type: str = "l2"
+
+    def as_meta(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    n_slots: int = 3
+    n_windows: int = 24
+    staleness: int = DEFAULT_STALENESS      # ticks per window AND bound
+    decay: float = DEFAULT_DECAY
+    ps_shards: int = 2
+    table: str = "lr"                       # PS placement rule table
+    host: str = "127.0.0.1"
+    port: int = 0                           # 0 = ephemeral
+    heartbeat_timeout: float = DEFAULT_HEARTBEAT_TIMEOUT
+    heartbeat_interval: float = 0.5
+    rpc_deadline: float = 30.0
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 8               # windows between center saves
+    policy: str = "elastic"                 # 'elastic' | 'restart'
+    plan_spec: str | None = None            # fault plan handed to workers
+    train: TrainTask = dataclasses.field(default_factory=TrainTask)
+
+    def __post_init__(self):
+        if self.n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {self.n_slots}")
+        if self.staleness < 1:
+            raise ValueError(
+                f"staleness must be >= 1, got {self.staleness}")
+        if self.policy not in ("elastic", "restart"):
+            raise ValueError(
+                f"unknown policy {self.policy!r}: 'elastic' (continue "
+                f"at reduced quorum) or 'restart' (the BSP gang-"
+                f"scheduled baseline: any death aborts for a full "
+                f"respawn from the checkpoint)")
+
+
+@dataclasses.dataclass
+class SlotState:
+    status: str = FREE
+    admit: int = 0                   # first window this worker owns
+    incarnation: int = 0             # fencing token: which JOIN owns
+    #                                  this slot (a zombie's frames
+    #                                  must never act on a replacement)
+    last_beat: float = 0.0
+    pushes: dict = dataclasses.field(default_factory=dict)
+    skips: set = dataclasses.field(default_factory=set)
+    delivered: int = -1              # newest window pushed or skipped
+    stats: dict = dataclasses.field(default_factory=dict)
+
+
+def init_center(task: TrainTask) -> dict:
+    """The step-0 center — zero weights over the biased feature width
+    (the SGD family's convention for this task)."""
+    return {"w": np.zeros((task.n_features + 1,), np.float32)}
+
+
+def center_accuracy(center: dict, task: TrainTask) -> float:
+    """Test accuracy of the center on the task's held-out tail —
+    numpy-only, so the coordinator can report convergence without a
+    device."""
+    from tpu_distalg.utils import datasets
+
+    X, y = datasets.synthetic_two_class(
+        task.n_rows + task.test_rows, task.n_features,
+        seed=task.data_seed)
+    X = datasets.add_bias_column(X)
+    X_te, y_te = X[task.n_rows:], y[task.n_rows:]
+    z = X_te @ np.asarray(center["w"], np.float32)
+    return float(np.mean((z > 0).astype(np.float32) == y_te))
+
+
+class ClusterAborted(RuntimeError):
+    """The run ended without completing (restart policy fired, or the
+    caller stopped it)."""
+
+
+class Coordinator:
+    """``start()`` binds and serves on daemon threads; ``wait()``
+    blocks to the result. One lock + condition guard all state; the
+    commit loop runs inside whichever handler completes a window."""
+
+    def __init__(self, config: ClusterConfig):
+        self.cfg = config
+        self.task = config.train
+        self.ps = psmod.ParameterServer(
+            init_center(self.task), table=config.table,
+            n_shards=config.ps_shards, decay=config.decay)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.slots = {i: SlotState() for i in range(config.n_slots)}
+        self.version = 0              # windows merged (the SSP clock)
+        self.gen = 0                  # membership generation
+        self.done = False
+        self.aborted: str | None = None
+        self.events: list[tuple] = []
+        self.hold_at: dict[int, int] = {}   # window -> required actives
+        self.worker_stats: dict[int, dict] = {}
+        self._next_incarnation = 1
+        self._threads: list[threading.Thread] = []
+        self._listener: socket.socket | None = None
+        self._stop = threading.Event()
+        self._tag = (f"cluster:{self.task.algo}:ssp:"
+                     f"{config.staleness}:{config.decay:g}")
+        self.port: int | None = None
+        self._maybe_resume()
+
+    # ------------------------------------------------------ lifecycle
+
+    def _maybe_resume(self) -> None:
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        if not self.cfg.checkpoint_dir:
+            return
+        restored = ckpt.restore_newest_with_fallback(
+            self.cfg.checkpoint_dir)
+        if restored is None:
+            return
+        payload, step = restored
+        saved_tag = ckpt.decode_tag(payload, self._tag)
+        if saved_tag != self._tag or "center" not in payload:
+            raise ValueError(
+                f"checkpoint in {self.cfg.checkpoint_dir} holds "
+                f"workload {saved_tag!r}, this cluster is "
+                f"{self._tag!r} — use a fresh directory")
+        center = {k: np.asarray(v)
+                  for k, v in payload["center"].items()}
+        self.ps = psmod.ParameterServer(
+            center, table=self.cfg.table,
+            n_shards=self.cfg.ps_shards, decay=self.cfg.decay)
+        self.version = int(step)
+        self.ps.version = self.version
+        tevents.emit("cluster_resume", version=self.version)
+
+    def start(self) -> "Coordinator":
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.cfg.host, self.cfg.port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop,
+                             name="tda-cluster-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        tevents.emit("cluster_start", port=self.port,
+                     n_slots=self.cfg.n_slots,
+                     n_windows=self.cfg.n_windows,
+                     resume_version=self.version)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def wait(self, timeout: float | None = None) -> dict:
+        """Block until done/aborted; returns the result dict. Raises
+        :class:`ClusterAborted` under the restart policy's abort (the
+        launcher catches it and respawns), and ``TimeoutError`` when
+        ``timeout`` expires first (the run keeps going)."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cond:
+            while not self.done and self.aborted is None:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"cluster run still at window {self.version}/"
+                        f"{self.cfg.n_windows} after {timeout}s")
+                self._cond.wait(timeout=0.2 if remaining is None
+                                else min(0.2, remaining))
+        if self.aborted is not None:
+            raise ClusterAborted(self.aborted)
+        return self.result()
+
+    def result(self) -> dict:
+        with self._lock:
+            center = self.ps.snapshot()
+            return {
+                "center": center,
+                "version": self.version,
+                "gen": self.gen,
+                "events": list(self.events),
+                "merge_sequence": self.merge_sequence(),
+                "membership_sequence": self.membership_sequence(),
+                "accuracy": center_accuracy(center, self.task),
+                "worker_stats": dict(self.worker_stats),
+            }
+
+    def hold_admission(self, window: int, n_active: int) -> None:
+        """Pin the admission of a (re)joining worker to a WINDOW: the
+        commit of ``window`` waits until ``n_active`` workers are
+        active. This is how the local launcher makes a rejoin land at
+        a plan-determined position in the event sequence (an
+        unsolicited late join is otherwise admitted at whatever window
+        the cluster happens to be at)."""
+        with self._cond:
+            self.hold_at[int(window)] = int(n_active)
+            self._cond.notify_all()
+
+    # ------------------------------------------------- event recording
+
+    def merge_sequence(self) -> list:
+        """The commit trace: ``(window, ((slot, age), ...), (skipped
+        slots...))`` per merge, in commit order — what the replay
+        acceptance compares bit-for-bit. Caller may hold the lock."""
+        return [e[1:] for e in self.events if e[0] == "merge"]
+
+    def membership_sequence(self) -> list:
+        """``(kind, slot, window)`` SORTED — concurrent connects make
+        same-window join ORDER (and so the generation numbers)
+        scheduler-dependent, so the comparable sequence projects the
+        plan-determined fields and is order-free within a window."""
+        return sorted((e[0], e[1], e[2]) for e in self.events
+                      if e[0] in ("join", "leave"))
+
+    def _emit_membership(self, reason: str, prev_active: int) -> None:
+        active = tuple(self.slots[i].status == ACTIVE
+                       for i in sorted(self.slots))
+        membership.emit_epoch_event(
+            membership.Epoch(gen=self.gen, start=self.version,
+                             end=self.cfg.n_windows, active=active),
+            reason=reason, prev_active=prev_active)
+        tevents.counter("cluster.membership_epochs")
+
+    # ------------------------------------------------------ accept/IO
+
+    def _accept_loop(self) -> None:
+        self._listener.settimeout(POLL_SECONDS)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                self._scan_heartbeats()
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # daemon handlers, deliberately untracked: a long-lived
+            # coordinator accepts one connection per join/heartbeat/
+            # rejoin forever, and an ever-growing thread list would be
+            # a slow leak (stop() ends them via the stop event/EOF)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="tda-cluster-conn", daemon=True).start()
+
+    def _scan_heartbeats(self) -> None:
+        """Declare slots whose last frame is older than the timeout
+        dead — the partition/hang detector (EOF catches clean deaths
+        faster, in the connection handler)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [i for i, st in self.slots.items()
+                     if st.status == ACTIVE and st.last_beat > 0
+                     and now - st.last_beat
+                     > self.cfg.heartbeat_timeout]
+            for slot in stale:
+                self._death(slot, "heartbeat timeout")
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """One connection's request loop. A worker's MAIN connection
+        binds to its slot AND its join incarnation; EOF on it is that
+        incarnation's death — never its replacement's (a zombie conn
+        outliving a heartbeat-timeout death must not kill the fresh
+        worker now holding the slot). Heartbeat connections never
+        join, so they never bind and their EOF is inert."""
+        bound_slot: int | None = None
+        bound_inc: int | None = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    kind, meta, arrays = transport.recv_frame(
+                        conn, deadline=max(
+                            self.cfg.rpc_deadline,
+                            4 * self.cfg.heartbeat_timeout))
+                except transport.TransportTimeout:
+                    continue  # idle connection; liveness rides beats
+                reply = self._handle(kind, meta, arrays, conn)
+                if kind == "join" and "slot" in reply[1]:
+                    bound_slot = int(reply[1]["slot"])
+                    bound_inc = int(reply[1]["incarnation"])
+                transport.send_frame(
+                    conn, *reply, deadline=self.cfg.rpc_deadline)
+                if kind == "bye":
+                    break
+        except transport.TransportClosed:
+            pass
+        except transport.TransportError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if bound_slot is not None:
+                with self._lock:
+                    st = self.slots.get(bound_slot)
+                    if st is not None and st.status == ACTIVE \
+                            and st.incarnation == bound_inc:
+                        self._death(bound_slot, "connection lost")
+
+    # ------------------------------------------------------- handlers
+
+    def _fenced(self, meta) -> SlotState | None:
+        """Lock held. The slot state a frame may act on: ACTIVE and,
+        when the frame carries an incarnation token (every frame a
+        welcomed worker sends), the SAME incarnation — a partitioned
+        zombie's late frames must neither feed the replacement's push
+        state nor keep its heartbeat fresh."""
+        slot = meta.get("slot")
+        if slot is None:
+            return None
+        st = self.slots.get(int(slot))
+        if st is None or st.status != ACTIVE:
+            return None
+        inc = meta.get("inc")
+        if inc is not None and int(inc) != st.incarnation:
+            return None
+        return st
+
+    def _handle(self, kind, meta, arrays, conn):
+        """Dispatch one frame -> ``(kind, meta, arrays)`` reply."""
+        with self._lock:
+            st = self._fenced(meta)
+            if st is not None:
+                st.last_beat = time.monotonic()
+        if kind == "join":
+            return self._handle_join(meta)
+        if kind == "push":
+            return self._handle_push(meta, arrays)
+        if kind == "skip":
+            return self._handle_skip(meta)
+        if kind in ("poll", "beat", "hb"):
+            with self._lock:
+                return ("ok", self._status_meta(), {})
+        if kind == "pull":
+            with self._lock:
+                return ("center", self._status_meta(),
+                        self.ps.snapshot())
+        if kind == "bye":
+            return self._handle_bye(meta)
+        return ("error", {"error": f"unknown frame kind {kind!r}"}, {})
+
+    def _status_meta(self) -> dict:
+        return {"version": self.version, "gen": self.gen,
+                "done": self.done,
+                "restart": self.aborted is not None}
+
+    def _handle_join(self, meta) -> tuple:
+        want = meta.get("slot")
+        with self._lock:
+            slot = None
+            if want is not None and int(want) in self.slots and \
+                    self.slots[int(want)].status != ACTIVE:
+                slot = int(want)
+            else:
+                for i in sorted(self.slots):
+                    if self.slots[i].status != ACTIVE:
+                        slot = i
+                        break
+            if slot is None:
+                return ("error", {
+                    "error": f"all {self.cfg.n_slots} slots active — "
+                             f"grow --workers to admit more"}, {})
+            prev_active = sum(s.status == ACTIVE
+                              for s in self.slots.values())
+            # a launcher-pinned admission window makes the rejoin's
+            # position in the event sequence plan-determined; an
+            # unsolicited join starts at the first uncommitted window
+            admit = max(self.version,
+                        int(meta.get("admit_at") or self.version))
+            admit = min(admit, max(0, self.cfg.n_windows - 1))
+            inc = self._next_incarnation
+            self._next_incarnation += 1
+            st = self.slots[slot] = SlotState(
+                status=ACTIVE, admit=admit, incarnation=inc,
+                last_beat=time.monotonic(),
+                delivered=admit - 1)
+            self.gen += 1
+            self.events.append(("join", slot, admit, self.gen))
+            tevents.emit("cluster_join", slot=slot, gen=self.gen,
+                         window=admit)
+            tevents.counter("cluster.joins")
+            self._emit_membership(
+                "rejoin" if meta.get("rejoin") else "join",
+                prev_active)
+            self._try_commit()
+            welcome = {
+                "slot": slot, "gen": self.gen,
+                "version": self.version,
+                "admit": st.admit,
+                "incarnation": st.incarnation,
+                "n_slots": self.cfg.n_slots,
+                "n_windows": self.cfg.n_windows,
+                "s": self.cfg.staleness,
+                "decay": self.cfg.decay,
+                "heartbeat_interval": self.cfg.heartbeat_interval,
+                "heartbeat_timeout": self.cfg.heartbeat_timeout,
+                "rpc_deadline": self.cfg.rpc_deadline,
+                "plan": self.cfg.plan_spec,
+                "train": self.task.as_meta(),
+                "done": self.done,
+            }
+            return ("welcome", welcome, self.ps.snapshot())
+
+    def _handle_skip(self, meta) -> tuple:
+        window = int(meta["window"])
+        with self._lock:
+            st = self._fenced(meta)
+            if st is None:
+                return ("error", {"error": "stale slot"}, {})
+            st.skips.add(window)
+            st.delivered = max(st.delivered, window)
+            # (no cluster.skips bump here: the WORKER owns that
+            # counter — in thread mode both sides share one sink and
+            # the merged report would double-count; the server-side
+            # story is cluster.skipped_deliveries at commit time)
+            self._try_commit()
+            return ("ok", self._status_meta(), {})
+
+    def _handle_push(self, meta, arrays) -> tuple:
+        window = int(meta["window"])
+        base = int(meta["base"])
+        with self._cond:
+            st = self._fenced(meta)
+            if st is None:
+                return ("error", {"error": "stale slot"}, {})
+            st.pushes[window] = (base, dict(arrays))
+            st.delivered = max(st.delivered, window)
+            # (no cluster.pushes bump: the worker owns it — see skip)
+            self._try_commit()
+            # the DEFERRED ack: reply once this window has merged —
+            # the pull piggybacks the post-commit center, and the
+            # worker's next base version is plan-determined instead of
+            # arrival-order-determined (the determinism contract)
+            while (self.version <= window and not self.done
+                   and self.aborted is None
+                   and self._fenced(meta) is st
+                   and not self._stop.is_set()):
+                self._cond.wait(timeout=0.2)
+            if self._fenced(meta) is not st:
+                return ("error", {"error": "declared dead while "
+                                           "awaiting commit"}, {})
+            return ("center", self._status_meta(), self.ps.snapshot())
+
+    def _handle_bye(self, meta) -> tuple:
+        slot = int(meta["slot"])
+        with self._lock:
+            st = self._fenced(meta)
+            if st is not None:
+                self.worker_stats[slot] = dict(meta.get("stats") or {})
+                self._record_worker_counters(slot)
+                if self.done or st.delivered >= self.cfg.n_windows - 1:
+                    # graceful departure: end-of-run, or a worker that
+                    # already delivered (pushed or skipped) everything
+                    # it owes and finished its last window before the
+                    # peers' final pushes commit — a DEATH here would
+                    # make the membership sequence race wall clock,
+                    # and under the restart policy would abort a
+                    # healthy completing run
+                    st.status = FREE
+                    self._try_commit()
+                    self._cond.notify_all()
+                else:
+                    self._death(slot, "graceful leave")
+            return ("ok", self._status_meta(), {})
+
+    def _record_worker_counters(self, slot: int) -> None:
+        stats = self.worker_stats.get(slot) or {}
+        ms = stats.get("push_pull_ms_total")
+        n = stats.get("pushes")
+        if ms is not None:
+            tevents.counter("cluster.push_pull_ms",
+                            int(round(float(ms))))
+        if n:
+            tevents.counter("cluster.worker_pushes", int(n))
+
+    # ------------------------------------------------ death & commits
+
+    def _death(self, slot: int, reason: str) -> None:
+        """Lock held. Membership leave + generation bump; the commit
+        that was blocked on this worker proceeds without it."""
+        st = self.slots[slot]
+        if st.status != ACTIVE:
+            return
+        prev_active = sum(s.status == ACTIVE
+                          for s in self.slots.values())
+        st.status = DEAD
+        self.gen += 1
+        self.events.append(
+            ("leave", slot, max(st.delivered, st.admit - 1) + 1,
+             self.gen, reason))
+        tevents.emit("cluster_leave", slot=slot, gen=self.gen,
+                     reason=reason, delivered=st.delivered)
+        tevents.counter("cluster.leaves")
+        self._emit_membership(f"leave:{reason}", prev_active)
+        if self.cfg.policy == "restart" and not self.done:
+            self._abort(f"worker {slot} died ({reason}); restart "
+                        f"policy aborts for a full respawn")
+            return
+        self._try_commit()
+        self._cond.notify_all()
+
+    def _abort(self, reason: str) -> None:
+        """Lock held. The restart-policy exit. Deliberately NO
+        checkpoint here: the gang-scheduled baseline restarts from the
+        last PERIODIC save and re-pays every window since — exactly
+        the progress loss the elastic policy exists to avoid (an
+        abort-time save would quietly gift the baseline lossless
+        restarts and flatter the measured speedup's denominator)."""
+        self.aborted = reason
+        tevents.emit("cluster_abort", reason=reason,
+                     version=self.version)
+        self._cond.notify_all()
+
+    def _expected(self, window: int) -> list[int]:
+        return [i for i in sorted(self.slots)
+                if self.slots[i].status == ACTIVE
+                and self.slots[i].admit <= window]
+
+    def _try_commit(self) -> None:
+        """Lock held. Drain every committable window: all expected
+        workers have pushed-or-skipped it (and any admission hold is
+        satisfied); apply pushes in slot order; bump the clock."""
+        while self.version < self.cfg.n_windows and not self.done \
+                and self.aborted is None:
+            w = self.version
+            need = self.hold_at.get(w)
+            expected = self._expected(w)
+            if need is not None and len(expected) < need:
+                return                       # admission hold
+            if not expected:
+                return                       # quorumless: wait for a join
+            if any(w not in self.slots[i].pushes
+                   and w not in self.slots[i].skips
+                   for i in expected):
+                return
+            contribs = []
+            skipped = []
+            for i in sorted(self.slots):     # dead workers' buffered
+                st = self.slots[i]           # pushes still count: they
+                if w in st.pushes:           # delivered before dying
+                    base, delta = st.pushes.pop(w)
+                    contribs.append((i, base, delta))
+                elif w in st.skips:
+                    st.skips.discard(w)
+                    skipped.append(i)
+            records = self.ps.merge(w, contribs)
+            self.version = w + 1
+            self.events.append((
+                "merge", w,
+                tuple((r["slot"], r["age"]) for r in records),
+                tuple(skipped)))
+            tevents.emit("cluster_merge", window=w,
+                         applied=records, skipped=skipped,
+                         n_active=len(expected))
+            tevents.counter("cluster.merges")
+            tevents.counter("cluster.deliveries", len(records))
+            tevents.counter("cluster.skipped_deliveries",
+                            len(skipped))
+            if records:
+                tevents.gauge(
+                    "cluster.max_staleness",
+                    max(r["age"] for r in records))
+            self._checkpoint()
+            if self.version >= self.cfg.n_windows:
+                self.done = True
+                self._checkpoint(force=True)
+                tevents.emit("cluster_done", version=self.version,
+                             gen=self.gen)
+            self._cond.notify_all()
+
+    def _checkpoint(self, force: bool = False) -> None:
+        """Lock held. Durable center save through the shared
+        checkpoint machinery (CRC footer, atomic rename, prune)."""
+        if not self.cfg.checkpoint_dir:
+            return
+        if not force and (self.version == 0
+                          or self.version % self.cfg.checkpoint_every):
+            return
+        from tpu_distalg.utils import checkpoint as ckpt
+
+        ckpt.save(self.cfg.checkpoint_dir,
+                  {"tag": ckpt.encode_tag(self._tag),
+                   "center": self.ps.snapshot()},
+                  step=self.version)
+        ckpt.prune(self.cfg.checkpoint_dir, keep=3)
+        tevents.emit("checkpoint_saved", step=self.version,
+                     tag=self._tag)
+        tevents.counter("checkpoints_saved")

@@ -1,0 +1,280 @@
+"""Local cluster launcher — N workers + coordinator on this machine.
+
+``tda cluster --role local --workers N``'s engine, and the harness the
+tests and bench drive: starts an in-process :class:`Coordinator`,
+spawns workers either as REAL OS processes (``spawn='process'`` — the
+``tda cluster --role worker`` CLI in a subprocess, where ``kill -9``
+is a genuine SIGKILL) or as threads (``spawn='thread'`` — same
+protocol over the same localhost sockets, a kill cell slams the
+sockets instead; fast enough for tier-1 tests and for bench arms
+where process-spawn noise would drown the measurement).
+
+Elastic supervision: when the plan's schedule kills a worker, the
+launcher respawns its slot once — under the plan WITH KILL RULES
+STRIPPED (``worker.strip_kills``: the fault was transient; a
+deterministic cell would re-kill every incarnation forever) — and
+pins the rejoin to a plan-determined window with
+``Coordinator.hold_admission`` so the replayed event sequence is
+identical. ``policy='restart'`` instead respawns the WHOLE cluster
+from the durable checkpoint on any death: the gang-scheduled
+BSP-restart baseline the bench's elastic-speedup ratio measures
+against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from tpu_distalg.cluster import worker as workermod
+from tpu_distalg.cluster.coordinator import (
+    ClusterAborted,
+    ClusterConfig,
+    Coordinator,
+)
+from tpu_distalg.faults import registry as fregistry
+
+#: windows a killed slot stays away before its replacement is admitted
+DEFAULT_REJOIN_AFTER = 3
+
+
+class _ThreadWorker:
+    """One thread-mode worker: the real protocol over real sockets;
+    its kill-cell ``die`` slams both sockets (EOF at the coordinator —
+    the same observable as a SIGKILL'd process)."""
+
+    def __init__(self, host, port, slot, *, rejoin=False,
+                 admit_at=None):
+        self.slot = slot
+        self.result: dict | None = None
+        self.error: Exception | None = None
+        self._socks: list = []
+        self._t = threading.Thread(
+            target=self._run, args=(host, port, slot, rejoin,
+                                    admit_at),
+            name=f"tda-cluster-worker{slot}", daemon=True)
+        self._t.start()
+
+    def _connect(self, *a, **kw):
+        from tpu_distalg.cluster import transport
+
+        s = transport.connect(*a, **kw)
+        self._socks.append(s)
+        return s
+
+    def _die(self):
+        # not a process: death = the sockets vanish, abruptly
+        for s in list(self._socks):
+            try:
+                s.shutdown(2)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        raise workermod.WorkerKilled()
+
+    def _run(self, host, port, slot, rejoin, admit_at):
+        try:
+            self.result = workermod.run_worker(
+                host, port, slot=slot, rejoin=rejoin,
+                admit_at=admit_at, die=self._die,
+                connect=self._connect)
+        except workermod.WorkerKilled:
+            self.result = {"killed": True}
+        except Exception as e:  # noqa: BLE001 — surfaced via .error
+            self.error = e
+
+    def join(self, timeout=None):
+        self._t.join(timeout)
+        return self.result
+
+    @property
+    def alive(self):
+        return self._t.is_alive()
+
+
+def _spawn_process_worker(host, port, slot, *, plan_spec,
+                          telemetry_dir, rejoin=False,
+                          admit_at=None):
+    """A REAL worker process via the CLI — ``kill -9`` here is the
+    genuine article. The worker's schedule comes from the
+    coordinator's welcome frame; the plan is NOT exported into the
+    child's environment (a worker-side registry would double-probe)."""
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("TDA_FAULT_PLAN", None)
+    cmd = [sys.executable, "-m", "tpu_distalg.cli", "cluster",
+           "--role", "worker", "--connect", f"{host}:{port}",
+           "--slot", str(slot)]
+    if rejoin:
+        cmd.append("--rejoin")
+    if admit_at is not None:
+        cmd += ["--admit-at", str(admit_at)]
+    if telemetry_dir:
+        cmd += ["--telemetry-dir",
+                os.path.join(telemetry_dir, f"worker-{slot}")]
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
+                      respawn: bool = True,
+                      rejoin_after: int = DEFAULT_REJOIN_AFTER,
+                      telemetry_dir: str | None = None,
+                      timeout: float = 600.0,
+                      logger=None) -> dict:
+    """Run one full cluster training locally; returns the
+    coordinator's result dict plus launcher bookkeeping
+    (``restarts``, ``respawns``, ``wall_seconds``).
+
+    * ``policy='elastic'`` (config): a killed worker's slot is
+      respawned once (``respawn=True``) under the kill-stripped plan,
+      admitted at the plan-determined window ``kill_window +
+      rejoin_after`` via an admission hold — so a chaos run's event
+      sequence replays identically.
+    * ``policy='restart'``: any death aborts; the WHOLE cluster
+      respawns from the checkpoint until the run completes — the
+      measured BSP-restart baseline.
+    """
+    log = logger or (lambda m: None)
+    t0 = time.monotonic()
+    plan_spec = config.plan_spec
+    restarts = 0
+    while True:
+        coord = Coordinator(config).start()
+        host, port = config.host, coord.port
+        schedule = workermod.compile_worker_schedule(
+            config.n_windows, config.n_slots,
+            plan=(fregistry.FaultPlan.parse(plan_spec)
+                  if plan_spec else None))
+        # first kill cell per slot (a slot dies at most once per
+        # incarnation; later cells are moot — the process is gone)
+        kill_cells: dict[int, int] = {}
+        for w, slot in zip(*np.nonzero(schedule == workermod.KILL)):
+            kill_cells.setdefault(int(slot), int(w))
+        if config.policy == "elastic" and respawn:
+            # pin every replacement's admission window up front: the
+            # event sequence becomes a pure function of the plan
+            for slot, w_kill in sorted(kill_cells.items()):
+                coord.hold_admission(
+                    min(w_kill + rejoin_after, config.n_windows - 1),
+                    config.n_slots)
+        workers = {}
+        for slot in range(config.n_slots):
+            workers[slot] = _start(spawn, host, port, slot,
+                                   telemetry_dir=telemetry_dir)
+        pending_respawn = (
+            {slot: min(w + rejoin_after, config.n_windows - 1)
+             for slot, w in kill_cells.items()}
+            if config.policy == "elastic" and respawn else {})
+        respawned: list[int] = []
+        try:
+            result = _supervise(coord, workers, pending_respawn,
+                                spawn, host, port, telemetry_dir,
+                                timeout, log, respawned)
+            result["restarts"] = restarts
+            # OBSERVED respawns (a death the supervisor actually saw
+            # and replaced), not the plan's kill-cell count — the
+            # bench's did-the-kill-really-fire guard reads this
+            result["respawns"] = len(respawned)
+            result["wall_seconds"] = round(time.monotonic() - t0, 3)
+            return result
+        except ClusterAborted as e:
+            restarts += 1
+            log(f"[cluster] aborted ({e}); restart policy respawns "
+                f"the whole cluster (restart {restarts})")
+            coord.stop()
+            _reap(workers, spawn)
+            # the transient fault already fired: the respawned job
+            # runs kill-free (worker.strip_kills), like a real
+            # executor loss
+            plan_spec = workermod.strip_kills(plan_spec)
+            config = dataclasses.replace(config, plan_spec=plan_spec)
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"restart-policy run exceeded {timeout}s") from e
+        finally:
+            coord.stop()
+
+
+def _start(spawn, host, port, slot, *, telemetry_dir,
+           rejoin=False, admit_at=None):
+    if spawn == "process":
+        return _spawn_process_worker(
+            host, port, slot, plan_spec=None,
+            telemetry_dir=telemetry_dir, rejoin=rejoin,
+            admit_at=admit_at)
+    return _ThreadWorker(host, port, slot, rejoin=rejoin,
+                         admit_at=admit_at)
+
+
+def _alive(h, spawn):
+    return (h.poll() is None) if spawn == "process" else h.alive
+
+
+def _reap(workers, spawn):
+    for h in workers.values():
+        if spawn == "process":
+            try:
+                # workers exit on their own once the coordinator says
+                # done — give them time to flush telemetry (a kill
+                # here would lose their counters event) before the
+                # hard reap
+                h.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                h.kill()
+                h.wait(timeout=30)
+        else:
+            h.join(timeout=30)
+
+
+def _supervise(coord, workers, pending_respawn, spawn, host, port,
+               telemetry_dir, timeout, log, respawned):
+    """Drive one incarnation to completion: wait on the coordinator,
+    respawning killed slots (elastic) as their deaths surface.
+    ``pending_respawn`` maps slot -> pinned admission window;
+    ``respawned`` collects the slots actually replaced."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            # short wait slices: a scheduled kill's respawn latency is
+            # bounded by this poll, and it sits on the elastic arm's
+            # measured wall clock
+            coord.wait(timeout=0.05)
+            _reap(workers, spawn)
+            # re-snapshot AFTER the workers' byes have landed, so the
+            # result carries their reported stats
+            return coord.result()
+        except TimeoutError:
+            if time.monotonic() > deadline:
+                coord.stop()
+                _reap(workers, spawn)
+                raise TimeoutError(
+                    f"cluster run still incomplete after {timeout}s "
+                    f"(version {coord.version}/{coord.cfg.n_windows})"
+                    ) from None
+        for slot in list(pending_respawn):
+            h = workers.get(slot)
+            if h is not None and _alive(h, spawn):
+                continue
+            # the kill landed; respawn the slot ONCE, its admission
+            # pinned to the plan-determined window (a rejoiner never
+            # re-executes windows before its admission, so the old
+            # kill cell cannot re-fire)
+            admit_at = pending_respawn.pop(slot)
+            respawned.append(slot)
+            log(f"[cluster] worker {slot} died on schedule; "
+                f"respawning (rejoin at window {admit_at})")
+            workers[slot] = _start(
+                spawn, host, port, slot,
+                telemetry_dir=telemetry_dir, rejoin=True,
+                admit_at=admit_at)

@@ -1,0 +1,168 @@
+"""Parameter-server tier — the center, sharded by partition rule tables.
+
+The coordinator process holds the replicated-center state the SGD
+family trains (``w`` for the LR/SSGD vocabulary) split across
+``n_shards`` PS shards, each with its own lock so concurrent merges on
+disjoint shards never serialize. WHICH leaves split and which stay
+whole is not re-decided here: the model's registered
+:mod:`~tpu_distalg.parallel.partition` rule table is consulted — a
+leaf whose spec shards its leading dim splits row-wise across the PS
+shards (``np.array_split``: UNEVEN splits are first-class, which is
+what a worker count that does not divide the model axis produces —
+the device-side mirror of this is ``partition.reshard``'s
+pad-reshard-slice path), a replicated-spec leaf lives whole on shard
+0. So the PS placement is the same one-rule-table-per-model contract
+the in-process trainers follow.
+
+The merge is the stale-synchronous weighted delta application of
+``parallel/ssp.py``, over the wire instead of a collective: each
+contribution carries its base version, its weight is ``decay**age``
+(``age = commit_window − base``, exactly ``ssp.staleness_weights``'
+exponent), and the center moves by the weighted MEAN of the delivered
+deltas — ``w += Σ wᵢ·Δᵢ / Σ wᵢ``, the same formula
+``ssgd.make_ssp_train_fn``'s window body applies on device. A commit
+nobody delivered to is a hard no-op (the in-process round-3 lesson:
+no epsilon divides).
+
+numpy-only: the PS applies host math; device placement is the
+workers' business.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tpu_distalg.parallel import partition
+from tpu_distalg.parallel.ssp import DEFAULT_DECAY
+
+
+def split_center(center: dict, table_name: str,
+                 n_shards: int) -> list[dict]:
+    """Per-PS-shard sub-dicts of ``center`` under the model's rule
+    table: sharded-spec leaves row-split (uneven OK), replicated-spec
+    leaves whole on shard 0. The union of the shards is exactly the
+    center (reassembled by :func:`join_center`)."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    tbl = partition.table(table_name)
+    shards: list[dict] = [{} for _ in range(n_shards)]
+    for name, leaf in center.items():
+        leaf = np.asarray(leaf)
+        spec = tbl.spec_for(name, leaf.shape)
+        sharded = any(entry is not None for entry in tuple(spec))
+        if sharded and leaf.ndim >= 1 and leaf.shape[0] >= 1:
+            for i, piece in enumerate(
+                    np.array_split(leaf, n_shards, axis=0)):
+                shards[i][name] = piece.copy()
+        else:
+            shards[0][name] = leaf.copy()
+    return shards
+
+
+def join_center(shards: list[dict]) -> dict:
+    """Inverse of :func:`split_center` (concatenate the split leaves in
+    shard order; whole leaves pass through)."""
+    out: dict = {}
+    names: list[str] = []
+    for sh in shards:
+        for name in sh:
+            if name not in names:
+                names.append(name)
+    for name in names:
+        pieces = [sh[name] for sh in shards if name in sh]
+        out[name] = (pieces[0].copy() if len(pieces) == 1
+                     else np.concatenate(pieces, axis=0))
+    return out
+
+
+class PsShard:
+    """One PS shard: its slice of every split leaf, one lock."""
+
+    def __init__(self, leaves: dict):
+        self.lock = threading.Lock()
+        self.leaves = {k: np.asarray(v, np.float32)
+                       if np.asarray(v).dtype.kind == "f"
+                       else np.asarray(v).copy()
+                       for k, v in leaves.items()}
+
+    def apply_weighted(self, contribs: list[tuple[float, dict]]) -> None:
+        """``leaf += Σ wᵢ·Δᵢ / Σ wᵢ`` for this shard's slice of every
+        delta — the ssp window merge, host-side. Empty ⇒ hard no-op."""
+        if not contribs:
+            return
+        wsum = float(sum(w for w, _ in contribs))
+        if wsum <= 0.0:
+            return
+        with self.lock:
+            for name in self.leaves:
+                acc = None
+                for w, delta in contribs:
+                    if name not in delta:
+                        continue
+                    term = np.float32(w) * np.asarray(delta[name],
+                                                      np.float32)
+                    acc = term if acc is None else acc + term
+                if acc is not None:
+                    self.leaves[name] = (
+                        self.leaves[name] + acc / np.float32(wsum))
+
+
+class ParameterServer:
+    """The tier: ``n_shards`` :class:`PsShard`\\ s over one model's
+    center, plus the version counter (= windows merged so far — the
+    number a contribution's age is measured against)."""
+
+    def __init__(self, center: dict, *, table: str = "lr",
+                 n_shards: int = 2, decay: float = DEFAULT_DECAY):
+        self.table = table
+        self.decay = float(decay)
+        self.n_shards = int(n_shards)
+        self.shards = [PsShard(s) for s in
+                       split_center(center, table, self.n_shards)]
+        self._version_lock = threading.Lock()
+        self.version = 0  # windows merged into the center
+
+    @staticmethod
+    def weight(decay: float, age: int) -> float:
+        """``decay**age`` — ssp.staleness_weights' exponent, scalar."""
+        return float(np.float32(decay) ** np.float32(max(0, age)))
+
+    def merge(self, commit_window: int,
+              contribs: list[tuple[int, int, dict]]) -> list[dict]:
+        """Apply one commit: ``contribs`` is ``[(slot, base, delta)]``
+        in SLOT order (the caller — the coordinator's commit loop —
+        owns the ordering, which is what makes the merge sequence a
+        pure function of the plan). Returns the per-contribution
+        records ``[{slot, base, age, weight}]``; bumps ``version``."""
+        records = []
+        weighted: list[tuple[float, list[dict]]] = []
+        for slot, base, delta in contribs:
+            # base = the center version (windows merged) the delta was
+            # computed against; a fresh delivery at window w has
+            # base == w (it adopted the post-commit-(w−1) center), so
+            # age = w − base = 0 — in-process ssp's winid − basegen
+            age = max(0, commit_window - int(base))
+            w = self.weight(self.decay, age)
+            records.append({"slot": int(slot), "base": int(base),
+                            "age": int(age), "weight": round(w, 6)})
+            # each delta splits under the SAME rule table as the
+            # center, so shard i applies exactly its slice
+            weighted.append(
+                (w, split_center(delta, self.table, self.n_shards)))
+        for i, shard in enumerate(self.shards):
+            shard.apply_weighted(
+                [(w, pieces[i]) for w, pieces in weighted])
+        with self._version_lock:
+            self.version = max(self.version, commit_window + 1)
+        return records
+
+    def snapshot(self) -> dict:
+        """The assembled center (copies, consistent per shard)."""
+        parts = []
+        for shard in self.shards:
+            with shard.lock:
+                parts.append({k: v.copy()
+                              for k, v in shard.leaves.items()})
+        return join_center(parts)

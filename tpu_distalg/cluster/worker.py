@@ -1,0 +1,479 @@
+"""Worker process — the existing SGD-family trainers behind push/pull.
+
+A worker owns one SLOT of the cluster's data (a contiguous row block
+of the coordinator-described task), builds its OWN local mesh
+(``get_mesh(data=1)`` over its host devices), and runs the EXISTING
+trainers' compiled window loops — ``ssgd.make_train_fn`` (per-tick
+minibatch SGD) or ``local_sgd.make_train_fn`` (the MA-family local
+rounds) — between push/pull seams: at each window boundary it pushes
+its accumulated center delta (``w_local − w_base``) with the base
+version it trained against, and the deferred ack returns the
+post-commit center it adopts next. Staleness weighting happens at the
+PS (``decay**age``); the worker's only clock duty is the GATE: it may
+not start window ``k`` until ``k − version ≤ s`` (the cross-process
+spelling of ``parallel/ssp.py``'s conservative bound).
+
+Fault schedule (plan-pure, like ``ssp.compile_straggle_schedule``):
+:func:`compile_worker_schedule` probes ``cluster:worker`` once per
+(window, slot) cell in row-major order against a fresh quiet registry
+— the same plan compiles the same schedule in every process, which is
+what makes a chaos run replayable. Cell kinds:
+
+  * ``straggle:u`` — the worker announces a SKIP for the window at its
+    START (so peers' commit never waits on the interference), then
+    pays ``u`` units of real compute (``ssp.straggle_work``) on top of
+    the window's ticks; its delta rides a later boundary, staler.
+  * ``kill`` — the worker runs HALF the window's ticks and then
+    ``kill -9``\\ s itself (``os.kill(getpid(), SIGKILL)``); in thread
+    mode the injected ``die`` slams the sockets instead, which is the
+    same observable (EOF at the coordinator).
+
+Liveness: a ``telemetry/heartbeat.py`` ``Heartbeat`` thread beats over
+a SECOND connection (``emit_fn`` both records the event and sends the
+frame), so a worker wedged in compute is still visibly alive and a
+partitioned one goes visibly silent.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from tpu_distalg.cluster import transport
+from tpu_distalg.faults import registry as fregistry
+from tpu_distalg.parallel import ssp as pssp
+from tpu_distalg.telemetry import events as tevents
+from tpu_distalg.telemetry import heartbeat as theartbeat
+
+#: per-slot sampling-seed stride: slots draw independent minibatches
+SLOT_SEED_STRIDE = 1_000_003
+#: how long the gate polls before giving up on a wedged coordinator
+GATE_DEADLINE_SECONDS = 300.0
+GATE_POLL_SECONDS = 0.02
+
+#: schedule cell code for a kill (straggle cells hold their +units)
+KILL = -1
+
+
+class WorkerKilled(Exception):
+    """Thread-mode stand-in for SIGKILL (the real worker never raises
+    this — it is gone)."""
+
+
+def compile_worker_schedule(n_windows: int, n_slots: int, *,
+                            plan=None) -> np.ndarray:
+    """The (n_windows, n_slots) int32 cluster fault schedule from the
+    plan's ``cluster:worker`` rules: cell > 0 = straggle units, cell
+    == -1 = kill. One probe per cell in row-major order against a
+    FRESH quiet registry (a pure function of the plan — every process
+    compiles the identical schedule); fires mirror into the live
+    ledger exactly once, like the SSP compilers."""
+    live = fregistry.active()
+    if plan is None:
+        plan = live.plan if live is not None else None
+    out = np.zeros((n_windows, n_slots), np.int32)
+    if plan is None or not any(
+            r.point == "cluster:worker" for r in plan.rules):
+        return out
+    reg = fregistry.FaultRegistry(plan, quiet=True)
+    for w in range(n_windows):
+        for k in range(n_slots):
+            hit = reg.probe("cluster:worker")
+            if hit is None:
+                continue
+            kind, arg = hit
+            if kind == "kill":
+                out[w, k] = KILL
+            else:
+                out[w, k] = int(arg if arg is not None
+                                else fregistry.DEFAULT_STRAGGLE_UNITS)
+    if live is not None and live.plan == plan:
+        live.record(reg.fired)
+    return out
+
+
+def strip_kills(plan_spec: str | None) -> str | None:
+    """The plan with its ``cluster:worker`` KILL rules removed — what a
+    respawned incarnation runs under (the fault was transient: a
+    restarted executor re-dying on the same deterministic cell would
+    loop forever, in both the elastic and the restart-baseline arms)."""
+    if not plan_spec:
+        return plan_spec
+    plan = fregistry.FaultPlan.parse(plan_spec)
+    rules = tuple(r for r in plan.rules
+                  if not (r.point == "cluster:worker"
+                          and r.kind == "kill"))
+    return fregistry.FaultPlan(seed=plan.seed, rules=rules).spec()
+
+
+def _slot_rows(task: dict, slot: int, n_slots: int):
+    """This slot's contiguous row block of the shared synthetic task
+    (the whole-task generation is deterministic in the data seed, so
+    every incarnation of a slot sees identical rows)."""
+    from tpu_distalg.utils import datasets
+
+    n_rows = int(task["n_rows"])
+    X, y = datasets.synthetic_two_class(
+        n_rows + int(task["test_rows"]), int(task["n_features"]),
+        seed=int(task["data_seed"]))
+    X = datasets.add_bias_column(X)
+    per = -(-n_rows // n_slots)
+    lo = min(slot * per, n_rows)
+    hi = min(lo + per, n_rows)
+    if hi <= lo:
+        raise ValueError(
+            f"slot {slot} owns no rows: {n_rows} rows over "
+            f"{n_slots} slots")
+    return (np.ascontiguousarray(X[lo:hi]),
+            np.ascontiguousarray(y[lo:hi]))
+
+
+class LocalTrainer:
+    """One slot's compiled window loops over the EXISTING trainers, on
+    the worker's own local mesh. ``run(w, window, n_ticks)`` executes
+    ``n_ticks`` local ticks starting at the window's absolute first
+    tick and returns the new local weights (host ndarray)."""
+
+    def __init__(self, task: dict, slot: int, n_slots: int, s: int):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_distalg.parallel import get_mesh
+
+        self.s = s
+        self.slot = slot
+        self.algo = task.get("algo", "ssgd")
+        X, y = _slot_rows(task, slot, n_slots)
+        self.X = jnp.asarray(X)
+        self.y = jnp.asarray(y)
+        self.valid = jnp.ones((X.shape[0],), jnp.float32)
+        d = X.shape[1]
+        self.dummy_te = (jnp.zeros((1, d), jnp.float32),
+                         jnp.zeros((1,), jnp.float32))
+        self.mesh = get_mesh(data=1, devices=jax.devices()[:1])
+        seed = int(task["seed"]) + SLOT_SEED_STRIDE * slot
+        self._fns: dict[int, object] = {}
+        if self.algo == "local_sgd":
+            from tpu_distalg.models import local_sgd as lsgd
+
+            def make(n_ticks):
+                cfg = lsgd.LocalSGDConfig(
+                    n_iterations=1, n_local_iterations=n_ticks,
+                    eta=float(task["eta"]),
+                    mini_batch_fraction=float(
+                        task["mini_batch_fraction"]),
+                    seed=seed, eval_test=False)
+                return lsgd.make_train_fn(self.mesh, cfg,
+                                          X.shape[0])
+        elif self.algo == "ssgd":
+            from tpu_distalg.models import ssgd
+
+            def make(n_ticks):
+                cfg = ssgd.SSGDConfig(
+                    n_iterations=n_ticks, eta=float(task["eta"]),
+                    mini_batch_fraction=float(
+                        task["mini_batch_fraction"]),
+                    lam=float(task["lam"]),
+                    reg_type=task.get("reg_type", "l2"),
+                    seed=seed, eval_test=False)
+                return ssgd.make_train_fn(self.mesh, cfg, X.shape[0])
+        else:
+            raise ValueError(
+                f"unknown cluster algo {self.algo!r}: 'ssgd' or "
+                f"'local_sgd'")
+        self._make = make
+
+    def run(self, w: np.ndarray, window: int, n_ticks: int
+            ) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        if n_ticks not in self._fns:
+            self._fns[n_ticks] = self._make(n_ticks)
+        fn = self._fns[n_ticks]
+        w_j = jnp.asarray(w, jnp.float32)
+        if self.algo == "local_sgd":
+            # one MA round of n_ticks local steps; t0 = the absolute
+            # ROUND id (the round scan's sampling key unit)
+            w_out, _ws, _delta, _accs = fn(
+                self.X, self.y, self.valid, *self.dummy_te,
+                w_j, w_j[None, :],
+                jnp.zeros_like(w_j), t0=window)
+        else:
+            # absolute tick ids thread the PRNG, so a window replay
+            # (or a respawned incarnation) samples identically
+            w_out, _accs = fn(self.X, self.y, self.valid,
+                              *self.dummy_te, w_j,
+                              t0=window * self.s)
+        return np.asarray(jax.block_until_ready(w_out), np.float32)
+
+    def straggle(self, units: int) -> None:
+        """Pay real interference compute (the compiled-in straggler of
+        ``parallel/ssp.py``, here an honest host-device burn)."""
+        import jax
+
+        jax.block_until_ready(
+            _straggle_fn()(np.int32(units * 50)))
+
+
+_STRAGGLE_CACHE: dict = {}
+
+
+def _straggle_fn():
+    import jax
+
+    fn = _STRAGGLE_CACHE.get("fn")
+    if fn is None:
+        fn = _STRAGGLE_CACHE["fn"] = jax.jit(
+            lambda u: pssp.straggle_work(u, 1.0))
+    return fn
+
+
+def _default_die():
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def run_worker(host: str, port: int, *, slot: int | None = None,
+               rejoin: bool = False, admit_at: int | None = None,
+               die=None, connect=None, logger=None) -> dict:
+    """The worker main loop: join → (gate → train window → push/skip)*
+    → bye. Returns its stats dict (the real process also reports them
+    in the ``bye`` frame and via its telemetry dir). ``die`` overrides
+    the kill-cell action for thread-mode tests (default: a real
+    ``SIGKILL`` on this process); ``connect`` overrides the dialer
+    (thread mode tracks its sockets through it). ``admit_at`` pins a
+    rejoiner's first window (the launcher's plan-determined admission
+    — the coordinator holds that window's commit for it)."""
+    log = logger or (lambda m: None)
+    die = die or _default_die
+    connect = connect or transport.connect
+    sock = connect(host, port)
+    for attempt in range(80):
+        kind, meta, center = transport.request(
+            sock, "join",
+            {"slot": slot, "rejoin": rejoin, "admit_at": admit_at})
+        if kind == "welcome":
+            break
+        if "slots active" in str(meta.get("error", "")) \
+                and attempt < 79:
+            # a replacement racing the coordinator's EOF processing of
+            # its predecessor: the slot reads ACTIVE for a beat after
+            # the old process died — retry briefly instead of wedging
+            # the admission hold forever
+            time.sleep(0.25)
+            continue
+        sock.close()
+        raise RuntimeError(
+            f"join rejected: {meta.get('error', kind)}")
+    slot = int(meta["slot"])
+    inc = int(meta.get("incarnation", 0))
+    # the fencing token: every frame this incarnation sends carries it,
+    # so a replacement can never be confused with its zombie
+    ident = {"slot": slot, "inc": inc}
+    s = int(meta["s"])
+    n_windows = int(meta["n_windows"])
+    n_slots = int(meta["n_slots"])
+    rpc_deadline = float(meta.get("rpc_deadline", 30.0))
+    task = meta["train"]
+    plan = meta.get("plan")
+    schedule = compile_worker_schedule(
+        n_windows, n_slots,
+        plan=fregistry.FaultPlan.parse(plan) if plan else None)
+    trainer = LocalTrainer(task, slot, n_slots, s)
+    tevents.emit("cluster_worker_start", slot=slot,
+                 admit=meta["admit"], gen=meta["gen"])
+    tevents.mark(f"cluster:worker{slot}", emit_event=False)
+
+    # liveness: the shared Heartbeat thread, its emit_fn ALSO framing a
+    # beat to the coordinator — compute-bound windows stay visibly
+    # alive, a partition goes visibly silent
+    hb_sock = connect(host, port)
+    hb_lock = threading.Lock()
+
+    def hb_emit(ev, **fields):
+        tevents.emit(ev, **fields)
+        if ev != "heartbeat":
+            return
+        with hb_lock:
+            transport.send_frame(hb_sock, "beat", dict(ident),
+                                 deadline=rpc_deadline)
+            transport.recv_frame(hb_sock, deadline=rpc_deadline)
+
+    hb = theartbeat.Heartbeat(
+        interval=float(meta.get("heartbeat_interval", 0.5)),
+        stall_after=None, emit_fn=hb_emit)
+    hb.start()
+
+    stats = {"pushes": 0, "skips": 0, "gated_ms": 0.0,
+             "push_pull_ms_total": 0.0, "push_pull_ms": [],
+             "ages": [], "windows": 0, "undelivered_windows": 0}
+    pending_windows = 0   # trained-but-not-yet-pushed (busy skips)
+    version = int(meta["version"])
+    w_base = np.asarray(center["w"], np.float32)
+    w_local = w_base.copy()
+    base = version
+    window = int(meta["admit"])
+    done = bool(meta.get("done"))
+    restart = False
+    killed = False
+    try:
+        if window > version:
+            # pinned late admission: wait for the clock to reach the
+            # admission window, then re-pull — the first delivery's
+            # base (and so its age/weight) is plan-determined, not
+            # join-timing-determined
+            t_gate = time.monotonic()
+            while version < window and not done and not restart:
+                if time.monotonic() - t_gate > GATE_DEADLINE_SECONDS:
+                    raise transport.TransportTimeout(
+                        f"admission starved: version {version} never "
+                        f"reached admit window {window}")
+                time.sleep(GATE_POLL_SECONDS)
+                _, m, _ = transport.request(
+                    sock, "poll", dict(ident),
+                    deadline=rpc_deadline)
+                version = int(m.get("version", version))
+                done = bool(m.get("done"))
+                restart = bool(m.get("restart"))
+            if not done and not restart:
+                _, m, arrays = transport.request(
+                    sock, "pull", dict(ident),
+                    deadline=rpc_deadline)
+                version = int(m.get("version", version))
+                w_base = np.asarray(arrays["w"], np.float32)
+                w_local = w_base.copy()
+                base = version
+        while window < n_windows and not done and not restart:
+            # the SSP gate: never more than s windows past the clock
+            t_gate = time.monotonic()
+            while window - version > s:
+                if time.monotonic() - t_gate > GATE_DEADLINE_SECONDS:
+                    raise transport.TransportTimeout(
+                        f"gate starved: window {window} vs version "
+                        f"{version} for {GATE_DEADLINE_SECONDS}s")
+                time.sleep(GATE_POLL_SECONDS)
+                _, m, _ = transport.request(
+                    sock, "poll", dict(ident),
+                    deadline=rpc_deadline)
+                version = int(m.get("version", version))
+                done = bool(m.get("done"))
+                restart = bool(m.get("restart"))
+                if done or restart:
+                    break
+            if done or restart:
+                break
+            if time.monotonic() - t_gate > 2 * GATE_POLL_SECONDS:
+                stats["gated_ms"] += (time.monotonic() - t_gate) * 1e3
+            cell = int(schedule[window, slot]) \
+                if window < schedule.shape[0] else 0
+            tevents.mark(f"cluster:worker{slot}@w{window}",
+                         emit_event=False)
+            if cell == KILL:
+                # kill -9 MID-WINDOW: half the ticks land, the push
+                # never happens, the sockets slam shut (EOF is the
+                # coordinator's fastest death signal)
+                w_local = trainer.run(w_local, window,
+                                      max(1, s // 2))
+                tevents.emit("cluster_worker_kill", slot=slot,
+                             window=window)
+                killed = True
+                die()
+                return stats          # thread-mode die() returns
+            busy = cell > 0
+            if busy:
+                # pre-announced skip: peers' commit of THIS window
+                # must not wait out the interference
+                _, m, _ = transport.request(
+                    sock, "skip", dict(ident, window=window),
+                    deadline=rpc_deadline)
+                version = int(m.get("version", version))
+                stats["skips"] += 1
+                tevents.counter("cluster.skips")
+            w_local = trainer.run(w_local, window, s)
+            stats["windows"] += 1
+            if busy:
+                trainer.straggle(cell)
+                pending_windows += 1
+                window += 1
+                continue
+            delta = w_local - w_base
+            t0 = time.monotonic()
+            # the ack is DEFERRED until this window commits — which
+            # can legitimately wait out an admission hold (a respawned
+            # PROCESS worker pays spawn + jax import + first compile),
+            # so the recv deadline is the gate's, not the rpc's
+            k2, m, arrays = transport.request(
+                sock, "push",
+                dict(ident, window=window, base=base),
+                {"w": delta},
+                deadline=max(rpc_deadline, GATE_DEADLINE_SECONDS))
+            rtt = (time.monotonic() - t0) * 1e3
+            if k2 == "error":
+                raise transport.TransportClosed(
+                    f"push rejected: {m.get('error')}")
+            stats["pushes"] += 1
+            stats["push_pull_ms"].append(round(rtt, 3))
+            stats["push_pull_ms_total"] += rtt
+            stats["ages"].append(max(0, window - base))
+            tevents.counter("cluster.pushes")
+            version = int(m.get("version", version))
+            done = bool(m.get("done"))
+            restart = bool(m.get("restart"))
+            # adopt the post-commit center: fresh base, zero delta
+            w_base = np.asarray(arrays["w"], np.float32)
+            w_local = w_base.copy()
+            base = version
+            pending_windows = 0
+            window += 1
+    finally:
+        hb.stop()
+        if not killed:
+            if pending_windows:
+                # a straggle cell on the FINAL window(s) leaves
+                # trained work with no later boundary to ride — the
+                # in-process SSP drops a boundary-busy final window's
+                # pending delta the same way (the scan ends); record
+                # the loss instead of letting it pass silently
+                stats["undelivered_windows"] = pending_windows
+                tevents.counter("cluster.undelivered_windows",
+                                pending_windows)
+                tevents.emit("cluster_undelivered", slot=slot,
+                             windows=pending_windows)
+            ages = stats.pop("ages", [])
+            stats["mean_age"] = (round(float(np.mean(ages)), 4)
+                                 if ages else 0.0)
+            stats["max_age"] = int(max(ages)) if ages else 0
+            rtts = stats.pop("push_pull_ms", [])
+            stats["push_pull_ms_p50"] = (
+                round(float(np.percentile(rtts, 50)), 3)
+                if rtts else 0.0)
+            try:
+                transport.request(
+                    sock, "bye", dict(ident, stats=stats),
+                    deadline=rpc_deadline)
+            except transport.TransportError:
+                pass
+            pssp.emit_ssp_counters(
+                pssp.SyncSpec(mode="ssp", staleness=s),
+                {"merges": stats["pushes"],
+                 "max_staleness": stats["max_age"],
+                 "mean_staleness": stats["mean_age"]},
+                straggle_ticks=stats["skips"] * s)
+            tevents.counter("cluster.gated_ms",
+                            int(stats["gated_ms"]))
+            tevents.emit("cluster_worker_done", slot=slot, **{
+                k: v for k, v in stats.items()
+                if not isinstance(v, list)})
+            log(f"[cluster] worker {slot} done: {stats['pushes']} "
+                f"push(es), {stats['skips']} skip(s)")
+            for s_ in (sock, hb_sock):
+                try:
+                    s_.close()
+                except OSError:
+                    pass
+    stats["restart"] = restart
+    return stats

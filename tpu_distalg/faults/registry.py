@@ -22,6 +22,16 @@ Injection points (wired at the call sites named):
   ``shard:leave``   elastic-membership epoch compilation
                     (``parallel/membership.compile_epochs``) — one
                     probe per (window boundary, shard), same ordering
+  ``cluster:worker``  multi-process worker schedule compilation
+                    (``cluster/worker.compile_worker_schedule``) — one
+                    probe per (window, slot) in row-major order; kinds
+                    ``kill`` (the worker SIGKILLs itself mid-window)
+                    and ``straggle`` (interference compute at the
+                    window boundary, delivery skipped while busy)
+  ``cluster:rpc``   the cluster transport's framed send/recv seams
+                    (``cluster/transport.py``) — ``oserror`` models a
+                    torn connection, ``hang`` a network partition the
+                    recv deadline / heartbeat timeout must observe
 
   ``ckpt:write``    ``utils/checkpoint.save`` — the bytes about to land
                     on disk (``corrupt`` really flips file bytes; the
@@ -110,15 +120,32 @@ POINTS = (
     "segment:run",
     "shard:straggle",
     "shard:leave",
+    "cluster:worker",
+    "cluster:rpc",
 )
 
 KINDS = ("oserror", "hang", "corrupt", "kill", "straggle", "leave")
 
 #: the SCHEDULING kinds: they fire at schedule-compilation seams via
 #: :func:`probe` (which returns the rule instead of raising) — the
-#: fault itself plays out inside the compiled SSP program, bitwise-
-#: replayable because the schedule is a pure function of the plan
-_SCHEDULING_KINDS = {"straggle": "shard:straggle", "leave": "shard:leave"}
+#: fault itself plays out inside the compiled SSP/cluster program,
+#: bitwise-replayable because the schedule is a pure function of the
+#: plan. A kind may be consumable at several points (``straggle`` is
+#: both the in-process SSP schedule's and the cluster worker
+#: schedule's interference kind).
+_SCHEDULING_KINDS = {"straggle": ("shard:straggle", "cluster:worker"),
+                     "leave": ("shard:leave",)}
+
+#: points that take ONLY a restricted kind set (schedule-compilation
+#: points take scheduling kinds; the cluster worker point also takes
+#: ``kill`` — probed, then acted out by the worker itself as a real
+#: SIGKILL; the rpc seam takes the transient transport kinds)
+_POINT_KINDS = {
+    "shard:straggle": ("straggle",),
+    "shard:leave": ("leave",),
+    "cluster:worker": ("straggle", "kill"),
+    "cluster:rpc": ("oserror", "hang"),
+}
 
 DEFAULT_HANG_SECONDS = 0.05
 DEFAULT_CORRUPT_BYTES = 8
@@ -172,17 +199,19 @@ class FaultRule:
                 f"fault probability must be in (0, 1], got {self.prob}")
         if self.hit is not None and self.hit < 0:
             raise ValueError(f"fault hit index must be >= 0, got {self.hit}")
-        want_point = _SCHEDULING_KINDS.get(self.kind)
-        if want_point is not None and self.point != want_point:
+        want_points = _SCHEDULING_KINDS.get(self.kind)
+        if want_points is not None and self.point not in want_points:
             raise ValueError(
-                f"scheduling kind {self.kind!r} fires at the "
-                f"{want_point!r} point only (got {self.point!r})")
-        if self.point in _SCHEDULING_KINDS.values() \
-                and want_point is None:
+                f"scheduling kind {self.kind!r} fires at "
+                f"{' / '.join(map(repr, want_points))} only "
+                f"(got {self.point!r})")
+        allowed = _POINT_KINDS.get(self.point)
+        if allowed is not None and self.kind not in allowed:
+            sched = all(k in _SCHEDULING_KINDS for k in allowed)
             raise ValueError(
-                f"point {self.point!r} takes scheduling kinds only "
-                f"({', '.join(sorted(_SCHEDULING_KINDS))}), got "
-                f"{self.kind!r}")
+                f"point {self.point!r} takes "
+                f"{'scheduling ' if sched else ''}kinds only "
+                f"({', '.join(allowed)}), got {self.kind!r}")
 
     def spec(self) -> str:
         where = (f"p{self.prob}" if self.prob is not None

@@ -353,19 +353,22 @@ def _check_sync_sampler(config: SSGDConfig) -> None:
     spec = pssp.SyncSpec.parse(config.sync)
     if not spec.is_ssp:
         return
-    if config.sampler != "bernoulli" or config.use_pallas \
-            or config.feature_sharded:
+    if config.sampler not in ("bernoulli", "fused", "fused_gather") \
+            or config.use_pallas or config.feature_sharded:
         raise ValueError(
             f"sync={config.sync!r} (stale-synchronous) composes with "
-            f"the 'bernoulli' sampler on a pure-dp mesh — got "
-            f"sampler={config.sampler!r} use_pallas={config.use_pallas} "
-            f"feature_sharded={config.feature_sharded}; the fused "
-            f"kernels and the tp split stay BSP")
+            f"the 'bernoulli', 'fused' and 'fused_gather' samplers on "
+            f"a pure-dp mesh — got sampler={config.sampler!r} "
+            f"use_pallas={config.use_pallas} "
+            f"feature_sharded={config.feature_sharded}; 'fused_train' "
+            f"(no per-window collective exists inside the megakernel), "
+            f"'fixed' and the tp split stay BSP")
 
 
 def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
                       d: int, *, active: tuple[bool, ...],
-                      n_win_seg: int, total_ticks: int):
+                      n_win_seg: int, total_ticks: int,
+                      meta: dict | None = None):
     """The SSP window scan: one compiled fn per (active set, segment
     window count), called per epoch segment by :func:`_train_ssp`.
 
@@ -387,7 +390,19 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
     average. A shard straggled AT the boundary keeps accumulating and
     delivers later at a staler weight — nothing is ever waited for,
     nothing is ever lost.
+
+    With ``meta`` (from ``pallas_kernels.pack_augmented``) the local
+    tick gradient runs the FUSED kernels instead of the XLA
+    bernoulli-mask path — ``config.sampler`` picks 'fused_gather'
+    (block-gather kernel, interpretable on CPU) or 'fused' (the
+    streaming one-pass kernel, TPU-only) — and the carry layout is
+    UNCHANGED (``ssp_init_state`` at ``d = meta['d_total']``): the
+    window/merge/gate algebra is sampler-independent, so at ``s=1``
+    on one shard the trajectory is bitwise the BSP fused trainer's
+    (the parity pin).
     """
+    import functools
+
     import numpy as np
 
     from tpu_distalg.parallel import DATA_AXIS, comms
@@ -399,9 +414,77 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
     key = prng.root_key(config.seed)
     active_np = np.asarray(active, bool)
     big = jnp.int32(1 << 30)
+    n_shards_m = int(mesh.shape[DATA_AXIS])
 
-    def window_body(X, y, masks, w, clocks, pend, basegen, wl, accd,
-                    res, extra, tickv, winid):
+    if meta is None:
+        payload_spec = P(None, "data")       # (s, rows) bernoulli masks
+
+        def tick_grad(X, y, w_l, payload_t):
+            return logistic.grad_sum(X, y, w_l, payload_t)
+
+        def window_payload(ts, valid):
+            return jax.vmap(
+                lambda t: sampling.bernoulli_mask(
+                    key, t, n_padded, config.mini_batch_fraction,
+                    valid))(ts)
+    else:
+        from jax import lax
+
+        from tpu_distalg.ops import pallas_kernels
+
+        on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+        d_t = meta["d_total"]
+        col_keep = (jnp.arange(d_t) < meta["y_col"]).astype(
+            jnp.float32)
+        if config.sampler == "fused_gather":
+            n_blocks, n_sampled = fused_gather_geometry(
+                config, meta, n_shards_m)
+            kern = functools.partial(
+                pallas_kernels.fused_grad_sum_gathered,
+                pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+                v_col=meta["v_col"],
+                gather_block_rows=config.gather_block_rows,
+                interpret=not on_tpu)
+            payload_spec = P(None, "data", None)  # (s, S, ns) draws
+
+            def tick_grad(X2, y, w_l, payload_t):
+                del y                            # packed into X2
+                g, cnt = kern(X2, w_l, payload_t[0])
+                return g * col_keep, cnt
+
+            def window_payload(ts, valid):
+                del valid                        # validity rides X2
+                return jax.vmap(
+                    lambda t: sampling.sample_block_ids(
+                        jax.random.fold_in(key, t),
+                        n_shards_m, n_blocks, n_sampled))(ts)
+        else:                                    # 'fused'
+            if not on_tpu:
+                raise ValueError(
+                    "sampler='fused' needs a TPU (the on-core PRNG "
+                    "has no interpret-mode lowering); use "
+                    "'fused_gather' or 'bernoulli' elsewhere")
+            kern = functools.partial(
+                pallas_kernels.fused_grad_sum_packed,
+                pack=meta["pack"], d_total=d_t, y_col=meta["y_col"],
+                v_col=meta["v_col"],
+                fraction=config.mini_batch_fraction,
+                block_rows=config.fused_block_rows)
+            payload_spec = P(None)               # (s,) absolute ticks
+
+            def tick_grad(X2, y, w_l, payload_t):
+                del y
+                shard = lax.axis_index(DATA_AXIS)
+                g, cnt = kern(X2, w_l, payload_t + config.seed,
+                              shard)
+                return g * col_keep, cnt
+
+            def window_payload(ts, valid):
+                del valid
+                return ts
+
+    def window_body(X, y, payloads, w, clocks, pend, basegen, wl,
+                    accd, res, extra, tickv, winid):
         from jax import lax
 
         my = lax.axis_index(DATA_AXIS)
@@ -425,7 +508,7 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
 
         def tick(carry, xs):
             w_l, acc, my_clock, gated_ct = carry
-            mask_l, extra_t, tv = xs
+            payload_t, extra_t, tv = xs
             # pad ticks (tv False, past total_ticks) pay NO
             # interference: the BSP A/B arm never runs them, so a
             # straggle cell landing in the padding would bias the
@@ -437,7 +520,7 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
             # the compiled-in straggler: real FLOPs on this shard only,
             # entangled below so the delay sits on the critical path
             dummy = pssp.straggle_work(eu, 1.0)
-            g, cnt = logistic.grad_sum(X, y, w_l, mask_l)
+            g, cnt = tick_grad(X, y, w_l, payload_t)
             reg = logistic.reg_gradient(
                 w_l, config.reg_type, config.elastic_alpha)
             upd = config.eta * (g / jnp.maximum(cnt, 1.0)
@@ -452,7 +535,7 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
 
         (wl, accd, my_clock, my_gated), _ = lax.scan(
             tick, (wl, accd, clocks_adj[my], jnp.int32(0)),
-            (masks, extra, tickv))
+            (payloads, extra, tickv))
 
         # the clock vector, combined via the comms layer (ints ride the
         # dense path of any schedule — a compressed count would corrupt
@@ -494,9 +577,9 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
     window_fn = data_parallel(
         window_body, mesh,
         in_specs=(
-            P("data", None),    # X rows
-            P("data"),          # y
-            P(None, "data"),    # masks (s, rows)
+            P("data", None),    # X rows (or the packed X2)
+            P("data"),          # y (a dummy on the fused paths)
+            payload_spec,       # per-tick sampling payload
             P(),                # center w
             P(), P(), P(),      # clocks, pend, basegen (replicated)
             P("data", None),    # per-shard local models (S, D)
@@ -515,14 +598,12 @@ def make_ssp_train_fn(mesh: Mesh, config: SSGDConfig, n_padded: int,
             i, extra_w = xs
             winid = (win0 + i).astype(jnp.int32)
             ts = winid * s + jnp.arange(s)
-            masks = jax.vmap(
-                lambda t: sampling.bernoulli_mask(
-                    key, t, n_padded, config.mini_batch_fraction,
-                    valid))(ts)
+            payloads = window_payload(ts, valid)
             tickv = ts < total_ticks
             (w, clocks, pend, basegen, wl, accd, res, amax, amean,
-             gated) = window_fn(X, y, masks, w, clocks, pend, basegen,
-                                wl, accd, res, extra_w, tickv, winid)
+             gated) = window_fn(X, y, payloads, w, clocks, pend,
+                                basegen, wl, accd, res, extra_w,
+                                tickv, winid)
             acc = (metrics.binary_accuracy(X_test @ w, y_test)
                    if config.eval_test else jnp.float32(0))
             return ((w, clocks, pend, basegen, wl, accd, res),
@@ -655,13 +736,43 @@ def _train_ssp(
     spec = pssp.SyncSpec.parse(config.sync)
     s = spec.staleness
     T = config.n_iterations
-    d = X_train.shape[1]
+    d_orig = X_train.shape[1]
     n_shards = int(mesh.shape[DATA_AXIS])
-    Xs = parallelize(X_train, mesh, dtype=jnp.dtype(config.x_dtype))
-    ys = parallelize(y_train, mesh)
-    X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
-    w0 = np.asarray(logistic.init_weights(
-        prng.root_key(config.init_seed), d), np.float32)
+    fused = config.sampler in ("fused", "fused_gather")
+    if fused:
+        # the packed-kernel SSP path: same carry (ssp_init_state at
+        # d_total), same window/merge algebra — only the local tick
+        # gradient runs the fused kernel (PR 9's named leftover)
+        _, X2, w0j, meta = prepare_fused(X_train, y_train, mesh,
+                                         config)
+        d = meta["d_total"]
+        w0 = np.asarray(w0j, np.float32)
+        data_x = X2
+        # labels/validity ride inside the packed X2; the dummies only
+        # satisfy the window program's sharded-arg signature
+        data_y = jnp.zeros((n_shards,), jnp.float32)
+        data_valid = jnp.zeros((n_shards,), jnp.float32)
+        n_padded = meta["n_padded"]
+        X_te = jnp.asarray(
+            np.pad(np.asarray(X_test, np.float32),
+                   ((0, 0), (0, d - d_orig))))
+        y_te = jnp.asarray(y_test)
+        tag = (f"ssgd:{config.sampler}:{spec.spec()}:"
+               f"comm={config.comm}")
+    else:
+        meta = None
+        d = d_orig
+        Xs = parallelize(X_train, mesh,
+                         dtype=jnp.dtype(config.x_dtype))
+        ys = parallelize(y_train, mesh)
+        data_x, data_y, data_valid = Xs.data, ys.data, Xs.mask
+        n_padded = Xs.n_padded
+        X_te, y_te = jnp.asarray(X_test), jnp.asarray(y_test)
+        w0 = np.asarray(logistic.init_weights(
+            prng.root_key(config.init_seed), d), np.float32)
+        # the pre-fused tag spelling: existing bernoulli checkpoint
+        # directories keep resuming
+        tag = f"ssgd:{spec.spec()}:comm={config.comm}"
     n_win, padded_ticks = pssp.window_grid(T, s)
     extra = pssp.compile_straggle_schedule(padded_ticks, n_shards)
     extra[T:] = 0  # pad ticks don't exist: no interference, no busy
@@ -685,8 +796,8 @@ def _train_ssp(
 
     def make_seg_fn(active, n_win_seg):
         return make_ssp_train_fn(
-            mesh, config, Xs.n_padded, d, active=active,
-            n_win_seg=n_win_seg, total_ticks=T)
+            mesh, config, n_padded, d, active=active,
+            n_win_seg=n_win_seg, total_ticks=T, meta=meta)
 
     def run_seg(fn, state, win0, n_win_seg, epoch):
         del epoch
@@ -701,7 +812,7 @@ def _train_ssp(
              "basegen": state[3], "wl": state[4], "accd": state[5],
              "res": state[6]},
             "ssgd", mesh)
-        out = fn(Xs.data, ys.data, Xs.mask, X_te, y_te,
+        out = fn(data_x, data_y, data_valid, X_te, y_te,
                  st["w"], st["clocks"], st["pend"], st["basegen"],
                  st["wl"], st["accd"], st["res"],
                  jnp.asarray(extra[win0:win0 + n_win_seg]),
@@ -719,10 +830,12 @@ def _train_ssp(
         # s-tick units and merge weights depend on decay, so a resume
         # under a DIFFERENT bound would silently reinterpret the saved
         # progress — it must reject like any other workload mismatch
-        tag=f"ssgd:{spec.spec()}:comm={config.comm}",
+        # (and the fused samplers carry their own tag: the augmented
+        # weight layout is not the XLA path's)
+        tag=tag,
         ticks_per_window=s)
 
-    w = jnp.asarray(np.asarray(state[0], np.float32))
+    w = jnp.asarray(np.asarray(state[0], np.float32))[:d_orig]
     metrics.guard_finite(w, "SSGD (ssp) weights")
     accs = window_accs_to_ticks(outs[0], s, T) if outs \
         else np.zeros((T,), np.float32)

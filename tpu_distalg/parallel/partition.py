@@ -285,6 +285,36 @@ def ensure(tree, tbl, mesh):
 # ------------------------------------------------------------- reshard
 
 
+def _spec_dim_degrees(spec, mesh) -> list[int]:
+    """Per-dimension shard degree the spec imposes (1 = that dim is
+    not cut)."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(1)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for ax in axes:
+            n *= int(mesh.shape[ax])
+        out.append(n)
+    return out
+
+
+def pad_amounts(shape, spec, mesh) -> tuple[int, ...]:
+    """Per-dimension tail padding that makes ``shape`` divisible by
+    the spec's shard degrees — all zeros when the layout is already
+    even (the historical fast path). The uneven case is exactly what
+    an elastic cluster shrinking to a worker count that does not
+    divide the model axis produces; the padding is inert zeros, the
+    ALS model-axis convention."""
+    degs = _spec_dim_degrees(spec, mesh)
+    return tuple(
+        ((-int(dim)) % degs[i]) if i < len(degs) and degs[i] > 1
+        else 0
+        for i, dim in enumerate(shape))
+
+
 def spec_shards(spec, mesh) -> int:
     """Number of distinct shards the spec cuts the array into on this
     mesh (product of the named axes' sizes; 1 == replicated)."""
@@ -318,7 +348,8 @@ def _canonical_spec(spec, mesh) -> tuple:
     return tuple(out)
 
 
-def _leaf_plan(shape, dtype, src_spec, dst_spec, mesh) -> dict:
+def _leaf_plan(shape, dtype, src_spec, dst_spec, mesh,
+               true_shape=None) -> dict:
     """Classify ONE leaf's src→dst transition into the collective
     class the pair requires and account its per-shard wire bytes
     under the comms layer's ring model (``CommSync.stats``):
@@ -340,11 +371,27 @@ def _leaf_plan(shape, dtype, src_spec, dst_spec, mesh) -> dict:
     exist for some factorizations); the program actually emitted is
     the XLA partitioner's lowering of the (src, dst) sharding pair —
     always device-side. ``bytes_host_roundtrip`` is what the gather +
-    re-put alternative moves over PCIe (full D2H + full H2D)."""
-    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    re-put alternative moves over PCIe (full D2H + full H2D).
+
+    UNEVEN dst layouts (a sharded dim the dst degree does not divide)
+    go pad-reshard-slice: the leaf is zero-padded up to divisibility
+    INSIDE the compiled program, moves at the padded size — which is
+    what ``bytes_wire``/``bytes_logical`` account, with the overhead
+    itemized as ``bytes_padding`` and the per-dim amounts as ``pad``
+    — and a later reshard back (``true_shapes``) slices the padding
+    off again. ``true_shape`` (when given) is the logical shape a
+    previously-padded input is first sliced back to."""
+    true = tuple(true_shape) if true_shape is not None else tuple(shape)
+    pads = pad_amounts(true, dst_spec, mesh)
+    moved = tuple(t + p for t, p in zip(true, pads))
+    itemsize = np.dtype(dtype).itemsize
+    nbytes = int(np.prod(moved)) if moved else 1
+    nbytes = int(nbytes * itemsize)
+    true_bytes = int((int(np.prod(true)) if true else 1) * itemsize)
     n_s = spec_shards(src_spec, mesh)
     n_d = spec_shards(dst_spec, mesh)
-    if _canonical_spec(src_spec, mesh) == \
+    reshaped = tuple(true) != tuple(shape) or any(pads)
+    if not reshaped and _canonical_spec(src_spec, mesh) == \
             _canonical_spec(dst_spec, mesh):
         op, wire = "noop", 0.0
     elif n_s == 1:
@@ -355,38 +402,55 @@ def _leaf_plan(shape, dtype, src_spec, dst_spec, mesh) -> dict:
         op, wire = "all_to_all", (nbytes / n_s) * (n_s - 1) / n_s
     else:
         op, wire = "gather_slice", nbytes * (n_s - 1) / n_s
-    return {"op": op, "bytes_wire": int(round(wire)),
+    plan = {"op": op, "bytes_wire": int(round(wire)),
             "bytes_logical": nbytes,
             "bytes_host_roundtrip": 0 if op == "noop" else 2 * nbytes}
+    if any(pads):
+        plan["pad"] = pads
+        plan["bytes_padding"] = nbytes - true_bytes
+        plan["padded_shape"] = moved
+    if tuple(true) != tuple(shape):
+        plan["true_shape"] = tuple(true)
+    return plan
 
 
-def reshard_stats(tree, src_tbl, dst_tbl, mesh) -> dict:
+def reshard_stats(tree, src_tbl, dst_tbl, mesh, *,
+                  true_shapes: dict | None = None) -> dict:
     """The whole tree's reshard plan + byte accounting (host-side,
-    static — no device work): per-leaf plans plus totals. Raises
+    static — no device work): per-leaf plans plus totals, including
+    ``bytes_padding`` — the inert-zero overhead uneven dst layouts
+    pay for divisibility (pad-reshard-slice). ``true_shapes`` maps
+    leaf name → pre-pad logical shape for inputs a PREVIOUS uneven
+    reshard padded (the slice half of the round trip). Raises
     :class:`PartitionRuleError` when either table fails to name a
     leaf (the tables must COVER the tree to reshard it)."""
     src_t, dst_t = table(src_tbl), table(dst_tbl)
     leaves: dict[str, dict] = {}
-    tot_wire = tot_logical = tot_host = n_moved = 0
+    tot_wire = tot_logical = tot_host = tot_pad = n_moved = 0
     for name, leaf in named_leaves(tree):
         shape = np.shape(leaf)
         dtype = getattr(leaf, "dtype", np.float32)
-        plan = _leaf_plan(shape, dtype,
-                          src_t.spec_for(name, shape),
-                          dst_t.spec_for(name, shape), mesh)
+        plan = _leaf_plan(
+            shape, dtype,
+            src_t.spec_for(name, shape),
+            dst_t.spec_for(name, shape), mesh,
+            true_shape=(true_shapes or {}).get(name))
         leaves[name] = plan
         tot_wire += plan["bytes_wire"]
         tot_logical += plan["bytes_logical"]
         tot_host += plan["bytes_host_roundtrip"]
+        tot_pad += plan.get("bytes_padding", 0)
         n_moved += plan["op"] != "noop"
     return {"leaves": leaves, "bytes_wire": tot_wire,
             "bytes_logical": tot_logical,
             "bytes_host_roundtrip": tot_host,
+            "bytes_padding": tot_pad,
             "n_leaves": len(leaves), "n_moved": n_moved,
             "src": src_t.name, "dst": dst_t.name}
 
 
-def reshard(tree, src_tbl, dst_tbl, mesh, *, emit: bool = True):
+def reshard(tree, src_tbl, dst_tbl, mesh, *, emit: bool = True,
+            true_shapes: dict | None = None):
     """Re-lay ``tree`` out from ``src_tbl``'s placement to
     ``dst_tbl``'s, DEVICE-SIDE: one compiled identity program whose
     ``out_shardings`` are the destination table's — the XLA
@@ -406,49 +470,111 @@ def reshard(tree, src_tbl, dst_tbl, mesh, *, emit: bool = True):
     padding conventions (ALS model-axis padding, parallelize row
     padding) guarantee that at the registered seams.
 
+    UNEVEN dst layouts are first-class via pad-reshard-slice: a leaf
+    whose sharded dim the dst degree does not divide is zero-padded
+    to divisibility INSIDE the same compiled program (one launch, no
+    extra host trip), lands in dst layout at the padded shape, and
+    the padding is itemized in :func:`reshard_stats`
+    (``bytes_padding`` / per-leaf ``pad``). Passing ``true_shapes``
+    (leaf name → logical shape) on a LATER reshard slices the padding
+    off on the way back out — the round trip is bitwise the original
+    (pinned by tests). Padded leaves are inert zeros past the true
+    extent, the ALS model-axis convention.
+
     Emits ``reshard.bytes_wire`` / ``bytes_logical`` / ``leaves`` /
     ``syncs`` counters plus a ``reshard`` event (rendered by
     ``tda report``); ``emit=False`` for accounting-free use in inner
     loops that batch their own telemetry."""
     import jax
 
-    st = reshard_stats(tree, src_tbl, dst_tbl, mesh)
+    st = reshard_stats(tree, src_tbl, dst_tbl, mesh,
+                       true_shapes=true_shapes)
     src = jax.tree.map(_stage, tree)
-    dst_sh = shardings(dst_tbl, tree, mesh)
-    out = _reshard_program(dst_sh)(src)
+    # destination shardings are computed at the FINAL (possibly
+    # padded/sliced) shapes — the scalar short-circuit and the rule
+    # match only consult shape via spec_for, which is shape-stable
+    # under tail padding for every registered table
+    final = _tree_map_named(
+        lambda name, leaf: jax.ShapeDtypeStruct(
+            tuple(st["leaves"][name].get(
+                "padded_shape",
+                st["leaves"][name].get("true_shape",
+                                       np.shape(leaf)))),
+            getattr(leaf, "dtype", np.float32)),
+        tree)
+    dst_sh = shardings(dst_tbl, final, mesh)
+    transforms = tuple(
+        (st["leaves"][name].get("true_shape"),
+         st["leaves"][name].get("pad"))
+        for name, _ in named_leaves(tree))
+    out = _reshard_program(dst_sh, transforms)(src)
     if emit:
         emit_reshard_counters(st)
     return out
 
 
-#: compiled reshard programs keyed by their destination-sharding tree
-#: — ``jax.jit`` caches on FUNCTION IDENTITY, so a fresh
-#: ``jit(lambda t: t, ...)`` per call would re-trace+compile every
-#: reshard (review-caught: ~8 ms/call forever vs ~10 µs cached); the
-#: hot seams (serve model builds, bench repeats) hit this cache
+#: compiled reshard programs keyed by (destination-sharding tree,
+#: per-leaf shape transforms) — ``jax.jit`` caches on FUNCTION
+#: IDENTITY, so a fresh ``jit(lambda t: t, ...)`` per call would
+#: re-trace+compile every reshard (review-caught: ~8 ms/call forever
+#: vs ~10 µs cached); the hot seams (serve model builds, bench
+#: repeats) hit this cache
 _RESHARD_PROGRAMS: dict = {}
 
 
-def _reshard_program(dst_sh):
+def _reshard_program(dst_sh, transforms=None):
     import jax
 
     leaves, treedef = jax.tree.flatten(dst_sh)
-    key = (treedef, tuple(leaves))
+    transforms = transforms or tuple((None, None) for _ in leaves)
+    key = (treedef, tuple(leaves), transforms)
     fn = _RESHARD_PROGRAMS.get(key)
     if fn is None:
+        def _apply(t):
+            import jax.numpy as jnp
+
+            flat, td = jax.tree.flatten(t)
+            out = []
+            for x, (true_shape, pads) in zip(flat, transforms):
+                # slice first (a previously-padded input's tail zeros
+                # come off), then pad for the dst degrees — both fuse
+                # into the ONE compiled relayout program
+                if true_shape is not None and \
+                        tuple(x.shape) != tuple(true_shape):
+                    x = x[tuple(slice(0, s) for s in true_shape)]
+                if pads is not None and any(pads):
+                    x = jnp.pad(x, [(0, int(p)) for p in pads])
+                out.append(x)
+            return jax.tree.unflatten(td, out)
+
         fn = _RESHARD_PROGRAMS[key] = jax.jit(
-            lambda t: t, out_shardings=dst_sh)
+            _apply, out_shardings=dst_sh)
     return fn
 
 
-def host_gather_reshard(tree, dst_tbl, mesh):
+def host_gather_reshard(tree, dst_tbl, mesh,
+                        true_shapes: dict | None = None):
     """The A/B baseline :func:`reshard` replaces: gather every leaf to
     THIS host (full D2H), then ``device_put`` back in the destination
     layout (full H2D) — ``2·B`` PCIe bytes per leaf and a host-RAM
     copy of the whole tree. Bitwise-identical output (both paths move
-    the same values; tests pin it); kept for the bench A/B and as the
+    the same values, including the uneven-layout pad/slice, applied
+    here on host; tests pin it); kept for the bench A/B and as the
     fallback spelling on meshes the compiled path cannot address."""
-    return place(gather(tree), dst_tbl, mesh)
+    dst_t = table(dst_tbl)
+    host = gather(tree)
+
+    def one(name, x):
+        true = (true_shapes or {}).get(name)
+        if true is not None and tuple(x.shape) != tuple(true):
+            x = x[tuple(slice(0, s) for s in true)]
+        pads = pad_amounts(np.shape(x),
+                           dst_t.spec_for(name, np.shape(x)), mesh)
+        if any(pads):
+            x = np.pad(x, [(0, int(p)) for p in pads])
+        return x
+
+    return place(_tree_map_named(one, host), dst_tbl, mesh)
 
 
 def emit_reshard_counters(st: dict) -> dict:

@@ -330,8 +330,112 @@ def render(s: dict) -> str:
     return "\n".join(lines)
 
 
-def report_main(path: str, as_json: bool = False, out=print) -> int:
-    """The ``tda report <dir>`` entry point."""
-    summary = summarize(load_events(path))
-    out(json.dumps(summary, indent=2) if as_json else render(summary))
+# counters the merged multi-directory rendering breaks out into
+# per-worker columns (the cluster runtime's per-process telemetry
+# dirs: DIR/coordinator + DIR/worker-N)
+PER_WORKER_PREFIXES = ("ssp.", "cluster.")
+
+
+def _natural_key(path: str):
+    """Numeric-aware sort key: ``worker-10`` sorts after ``worker-9``,
+    not between ``worker-1`` and ``worker-2``."""
+    import re
+
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", os.path.basename(
+                os.path.normpath(path)))]
+
+
+def expand_dirs(paths: list[str]) -> list[str]:
+    """Resolve the report inputs: each path is an event file, an event
+    directory, or a PARENT of per-worker event directories (the
+    ``tda cluster --telemetry-dir`` layout) — parents expand to their
+    event-bearing children, sorted by name so worker columns render in
+    slot order."""
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        has_own = bool(glob.glob(os.path.join(path,
+                                              "events-*.jsonl")))
+        children = sorted(
+            (d for d in glob.glob(os.path.join(path, "*"))
+             if os.path.isdir(d)
+             and glob.glob(os.path.join(d, "events-*.jsonl"))),
+            key=_natural_key)
+        if children:
+            # a parent of per-worker dirs; its own stray events (if
+            # any) still count as one more column
+            out.extend(([path] if has_own else []) + children)
+            continue
+        # no event-bearing children: the dir itself (load_events
+        # raises its remedy-carrying FileNotFoundError when it holds
+        # nothing either)
+        out.append(path)
+    return out
+
+
+def summarize_multi(paths: list[str]) -> dict:
+    """Per-directory summaries + one MERGED view: counters summed,
+    events/metrics/faults pooled — ``{"merged": ..., "workers":
+    {label: summary}}`` where labels are the directory basenames."""
+    workers: dict[str, dict] = {}
+    all_events: list[dict] = []
+    for p in paths:
+        evts = load_events(p)
+        label = os.path.basename(os.path.normpath(p)) or p
+        base, n = label, 2
+        while label in workers:
+            label = f"{base}#{n}"
+            n += 1
+        workers[label] = summarize(evts)
+        all_events.extend(evts)
+    return {"merged": summarize(all_events), "workers": workers}
+
+
+def render_multi(multi: dict) -> str:
+    """The merged rendering: the usual report over the pooled events,
+    then a per-worker column table for the ``ssp.*`` / ``cluster.*``
+    counters — how a cluster run's straggle/gate/push behavior reads
+    side by side across processes."""
+    lines = [f"merged over {len(multi['workers'])} telemetry dir(s): "
+             + ", ".join(multi["workers"]),
+             render(multi["merged"])]
+    names = sorted({
+        name
+        for s in multi["workers"].values()
+        for name in s["counters"]
+        if name.startswith(PER_WORKER_PREFIXES)})
+    if names:
+        labels = list(multi["workers"])
+        widths = [max(len(lb), 8) for lb in labels]
+        name_w = max(len(n) for n in names)
+        header = " ".join([" " * name_w] + [
+            lb.rjust(w) for lb, w in zip(labels, widths)])
+        lines.append("per-worker counters (ssp.*/cluster.*):")
+        lines.append("  " + header)
+        for name in names:
+            row = [name.ljust(name_w)]
+            for lb, w in zip(labels, widths):
+                v = multi["workers"][lb]["counters"].get(name, "-")
+                row.append(str(v).rjust(w))
+            lines.append("  " + " ".join(row))
+    return "\n".join(lines)
+
+
+def report_main(path, as_json: bool = False, out=print) -> int:
+    """The ``tda report <dir>...`` entry point: one directory renders
+    the classic single-run report; several (or a parent of per-worker
+    dirs) render the merged report with per-worker counter columns."""
+    paths = expand_dirs([path] if isinstance(path, str) else
+                        list(path))
+    if len(paths) == 1:
+        summary = summarize(load_events(paths[0]))
+        out(json.dumps(summary, indent=2) if as_json
+            else render(summary))
+        return 0
+    multi = summarize_multi(paths)
+    out(json.dumps(multi, indent=2) if as_json
+        else render_multi(multi))
     return 0
