@@ -1155,12 +1155,27 @@ def run_cluster_bench(emit, *, fast: bool = False):
     (framed delta up, merge, framed center back): the transport +
     merge cost floor every window pays.
 
-    Both RAISE instead of emitting fabricated values when a run fails
-    to complete (the serve-round-3 lesson: a fabricated number poisons
-    the tripwire reference).
+    ``cluster_coordinator_recovery_ms`` — median measured
+    detect→recover→first-recommitted-window latency when the
+    COORDINATOR is killed mid-window by a seeded
+    ``cluster:coordinator`` plan: the launcher respawns it on the
+    same port, it replays the durable WAL on top of the newest center
+    checkpoint, the surviving workers reconnect + re-push, and the
+    clock stamps at the first post-recovery commit. The same run's
+    final center is asserted BITWISE-identical to an undisturbed
+    run's (recovery must not tax correctness), and the elastic-
+    speedup arm above re-runs every round to show the WAL doesn't tax
+    the no-fault path.
+
+    All three RAISE instead of emitting fabricated values when a run
+    fails to complete or a scheduled fault never fires (the
+    serve-round-3 lesson: a fabricated number poisons the tripwire
+    reference).
     """
     import dataclasses
     import tempfile
+
+    import numpy as _np
 
     from tpu_distalg import cluster as clus
 
@@ -1242,6 +1257,78 @@ def run_cluster_bench(emit, *, fast: bool = False):
                 "(framed delta up, staleness-weighted merge, framed "
                 "center back) on an idle single-worker cluster — the "
                 "per-window transport+merge cost floor",
+    })
+
+    # coordinator crash tolerance: kill the CONTROL PLANE mid-window
+    # (seeded cluster:coordinator plan), measure detect -> WAL replay
+    # -> worker reconnects -> first recommitted window, over several
+    # kills for a median. The recovered run must be BITWISE-identical
+    # to the undisturbed elastic arm above (same task, no worker
+    # faults) — recovery that taxes correctness is not recovery.
+    kills = 2 if fast else 5
+    rec_ms: list = []
+    kill_centers: list = []
+    for k in range(kills):
+        coord_w = windows // 2
+        plan_c = f"seed={11 + k};cluster:coordinator@{coord_w}=kill"
+        with tempfile.TemporaryDirectory(
+                prefix="tda_cluster_c_") as d:
+            res_c = clus.run_local_cluster(
+                clus.ClusterConfig(
+                    n_slots=CLUSTER_SLOTS, n_windows=windows,
+                    # generous: a loaded box must not flip a slow
+                    # reconnect into a readmission (a legitimate
+                    # degraded path that would fail the bitwise
+                    # acceptance below for the wrong reason)
+                    staleness=s, heartbeat_timeout=15.0,
+                    plan_spec=plan_c, train=task,
+                    checkpoint_every=ce, checkpoint_dir=d),
+                spawn="thread", timeout=300.0)
+        if res_c["version"] != windows:
+            raise RuntimeError(
+                f"coordinator-kill run {k} stopped at window "
+                f"{res_c['version']}/{windows} — recovery failed, "
+                f"no latency can be claimed")
+        if res_c["coordinator_recoveries"] != 1 or \
+                not res_c["recovery_ms"]:
+            raise RuntimeError(
+                f"the seeded coordinator kill never fired or was "
+                f"never measured (recoveries="
+                f"{res_c['coordinator_recoveries']}, recovery_ms="
+                f"{res_c['recovery_ms']}) — refusing to fabricate "
+                f"a recovery latency")
+        rec_ms.extend(res_c["recovery_ms"])
+        kill_centers.append(res_c["center"]["w"])
+    # bitwise acceptance vs an undisturbed run of the same config —
+    # EVERY kill run's center, not just the last one's (a divergence
+    # in any run must not ship inside the median)
+    res_u = clus.run_local_cluster(
+        clus.ClusterConfig(
+            n_slots=CLUSTER_SLOTS, n_windows=windows, staleness=s,
+            heartbeat_timeout=3.0, train=task),
+        spawn="thread", timeout=300.0)
+    for k, center in enumerate(kill_centers):
+        if not _np.array_equal(center, res_u["center"]["w"]):
+            raise RuntimeError(
+                f"recovered center of kill run {k} diverged from "
+                f"the undisturbed run — the WAL replay/rollback "
+                f"contract is broken; refusing to emit a recovery "
+                f"latency for an incorrect recovery")
+    emit({
+        "metric": "cluster_coordinator_recovery_ms",
+        "value": round(float(_np.percentile(rec_ms, 50)), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "kills": kills,
+        "recovery_ms_all": [round(float(x), 3) for x in rec_ms],
+        "wal_records_replayed": res_c["wal_records_replayed"],
+        "bitwise_vs_undisturbed": True,
+        "note": "median detect->recover->first-recommitted-window "
+                "after a seeded kill of the coordinator mid-window: "
+                "launcher respawn on the same port + WAL replay over "
+                "the newest durable center + worker reconnect/"
+                "re-push; final center bitwise-identical to the "
+                "undisturbed run (asserted, not assumed)",
     })
 
 
@@ -2569,6 +2656,7 @@ ALL_METRIC_NAMES = (
     "ssgd_ssp_equal_loss_steps",
     "ssgd_cluster_elastic_speedup",
     "cluster_push_pull_ms",
+    "cluster_coordinator_recovery_ms",
     "ssgd_lr_100m_rows_steps_per_sec_per_chip",
     "ssgd_lr_1b_rows_virtual_steps_per_sec_per_chip",
     "ssgd_lr_32gb_streamed_steps_per_sec_per_chip",
@@ -2596,7 +2684,8 @@ ALL_METRIC_NAMES = (
 #: never flags an improvement
 LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",
                                      "ssgd_ssp_equal_loss_steps",
-                                     "cluster_push_pull_ms"))
+                                     "cluster_push_pull_ms",
+                                     "cluster_coordinator_recovery_ms"))
 
 #: canonical units, for the skipped-with-zero lines
 _METRIC_UNITS = {
@@ -2615,6 +2704,7 @@ _METRIC_UNITS = {
     "ssgd_ssp_equal_loss_steps": "x",
     "ssgd_cluster_elastic_speedup": "x",
     "cluster_push_pull_ms": "ms",
+    "cluster_coordinator_recovery_ms": "ms",
     "ring_attention_32k_tokens_per_sec_per_chip": "tokens/s/chip",
     "ring_attention_32k_fwd_bwd_tokens_per_sec_per_chip":
         "tokens/s/chip",

@@ -104,6 +104,12 @@ CLAIMS = [
      r"baseline", 1.0),
     ("cluster_push_pull_ms",
      r"push/pull round trip under \*\*([\d.]+?)\s*ms\*\*", 1.0),
+    # coordinator crash tolerance (round 17): detect -> WAL replay ->
+    # worker reconnect -> first recommitted window, a CEILING (lower
+    # is better; recovery must stay invisible-fast)
+    ("cluster_coordinator_recovery_ms",
+     r"coordinator kill -9 recovers in under "
+     r"\*\*([\d.]+?)\s*ms\*\*", 1.0),
     # online serving layer (round 13): throughput claimed as a floor
     # and the scoring p99 as a CEILING until the first real-backend
     # round records the achieved numbers (cpu-tagged fallback lines
@@ -145,6 +151,7 @@ CEILING_CLAIMS = frozenset((
     "serve_lr_p99_ms",
     "ssgd_ssp_equal_loss_steps",
     "cluster_push_pull_ms",
+    "cluster_coordinator_recovery_ms",
 ))
 
 

@@ -1265,3 +1265,117 @@ def test_tda090_nested_scope_needs_its_own_deadline():
         return inner               #   cover this socket
     """
     assert codes(lint(src, path=CLUS)) == ["TDA090"]
+
+
+# ---------------------------------------------------------------- TDA091
+
+
+def test_tda091_raw_write_without_fsync_flagged():
+    bad = """
+    def publish(path, buf):
+        with open(path, "wb") as f:
+            f.write(buf)
+    """
+    got = codes(lint(bad, path=CLUS))
+    assert "TDA091" in got
+    # scope: only tpu_distalg/cluster/ (TDA030 polices the rest)
+    assert "TDA091" not in codes(lint(bad, path=LIB))
+    # append mode is durable bytes too — the WAL's own mode
+    bad_append = """
+    def log_record(path, buf):
+        with open(path, "ab") as f:
+            f.write(buf)
+    """
+    assert "TDA091" in codes(lint(bad_append, path=CLUS))
+    good = """
+    import os
+
+    def publish(path, buf):
+        with open(path, "ab") as f:
+            f.write(buf)
+            f.flush()
+            os.fsync(f.fileno())
+    """
+    assert lint(good, path=CLUS) == []
+
+
+def test_tda091_rename_without_fsync_flagged():
+    bad = """
+    import os
+
+    def swap(a, b):
+        os.replace(a, b)
+    """
+    assert "TDA091" in codes(lint(bad, path=CLUS))
+    good = """
+    import os
+
+    def swap(d, a, b):
+        os.replace(a, b)
+        fd = os.open(d, os.O_RDONLY)
+        os.fsync(fd)
+        os.close(fd)
+    """
+    # (TDA030's seam-coverage concern may still apply; TDA091's
+    # durability-discipline one is satisfied by the fsync)
+    assert "TDA091" not in codes(lint(good, path=CLUS))
+
+
+def test_tda091_wal_append_must_fsync_before_send():
+    bad = """
+    import os
+    from tpu_distalg.cluster.transport import send_frame
+
+    def commit(f, sock, rec):
+        f.write(rec)
+        send_frame(sock, "ack", {})
+    """
+    assert codes(lint(bad, path=CLUS)) == ["TDA091"]
+    # flush alone is NOT durability — the fsync is the contract
+    flush_only = """
+    import os
+    from tpu_distalg.cluster.transport import send_frame
+
+    def commit(f, sock, rec):
+        f.write(rec)
+        f.flush()
+        send_frame(sock, "ack", {})
+    """
+    assert codes(lint(flush_only, path=CLUS)) == ["TDA091"]
+    good = """
+    import os
+    from tpu_distalg.cluster.transport import send_frame
+
+    def commit(f, sock, rec):
+        f.write(rec)
+        f.flush()
+        os.fsync(f.fileno())
+        send_frame(sock, "ack", {})
+    """
+    assert lint(good, path=CLUS) == []
+    # a send BEFORE the write is not gated on it
+    reply_first = """
+    import os
+
+    def reply_then_log(f, sock, buf, rec):
+        sock.sendall(buf)
+        f.write(rec)
+        f.flush()
+        os.fsync(f.fileno())
+    """
+    assert "TDA091" not in codes(lint(reply_first, path=CLUS))
+    # the pairing judges the FIRST later send: an unfsynced nearer
+    # ack must not hide behind a safe farther one (AST-walk order is
+    # arbitrary — the rule sorts by source line)
+    near_ack_unsafe = """
+    import os
+    from tpu_distalg.cluster.transport import send_frame
+
+    def commit(f, sock, rec):
+        f.write(rec)
+        send_frame(sock, "ack1", {})
+        f.flush()
+        os.fsync(f.fileno())
+        send_frame(sock, "ack2", {})
+    """
+    assert "TDA091" in codes(lint(near_ack_unsafe, path=CLUS))

@@ -177,4 +177,122 @@ class ClusterTransportDiscipline(Rule):
                         "send_frame")
 
 
-RULES = (ClusterTransportDiscipline(),)
+def _calls_fsync(fn: ast.AST) -> bool:
+    """True when the function calls an fsync (``os.fsync`` or a
+    ``*fsync*`` helper like the WAL's ``_fsync_dir``) — the marker of
+    the fsync-rename discipline."""
+    for call in _direct_calls(fn):
+        name = None
+        if isinstance(call.func, ast.Attribute):
+            name = call.func.attr
+        elif isinstance(call.func, ast.Name):
+            name = call.func.id
+        if name is not None and "fsync" in name:
+            return True
+    return False
+
+
+def _write_capable_mode(call: ast.Call) -> str | None:
+    """The mode string of an ``open`` call when it can MUTATE the file
+    (w/x/a/+ — append is exactly the WAL's mode, and durable bytes
+    are durable bytes), else None."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and \
+            isinstance(mode.value, str) and \
+            any(c in mode.value for c in "wxa+"):
+        return mode.value
+    return None
+
+
+class WalDurabilityDiscipline(Rule):
+    code = "TDA091"
+    name = ("file write outside the WAL/checkpoint fsync-rename "
+            "discipline, or a WAL append not durable before the "
+            "socket send")
+    invariant = (
+        "the coordinator's crash-tolerance contract is write-AHEAD: "
+        "durable state in tpu_distalg/cluster/ is mutated only "
+        "inside fsync-disciplined helpers (cluster/wal.py, "
+        "utils/checkpoint), and a record's bytes are flushed+fsynced "
+        "BEFORE the ack that depends on them leaves the socket — a "
+        "buffered write that an ack escapes ahead of is a recovery "
+        "that silently forgets acknowledged state")
+
+    def applies(self, ctx):
+        return "tpu_distalg/cluster/" in ctx.path
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef,
+                               ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx, fn):
+        has_fsync = _calls_fsync(fn)
+        writes, sends, flushes, fsyncs = [], [], [], []
+        for call in _direct_calls(fn):
+            name = call_name(call)
+            method = _attr_method(call)
+            if name == "open":
+                mode = _write_capable_mode(call)
+                if mode is not None and not has_fsync:
+                    yield self.violation(
+                        ctx, call,
+                        f"open(..., {mode!r}) in cluster/ with no "
+                        f"fsync in this function — durable cluster "
+                        f"state goes through the WAL/checkpoint "
+                        f"fsync-rename helpers (cluster/wal.py, "
+                        f"utils/checkpoint), not ad-hoc writes a "
+                        f"crash can tear silently")
+            elif name in ("os.replace", "os.rename") \
+                    and not has_fsync:
+                yield self.violation(
+                    ctx, call,
+                    f"{name}() in cluster/ with no fsync in this "
+                    f"function — a rename-publish whose directory "
+                    f"entry a power cut can lose; use the "
+                    f"WAL/checkpoint fsync-rename helpers")
+            if method == "write":
+                writes.append(call)
+            elif method == "sendall" or (
+                    name is not None
+                    and name.rsplit(".", 1)[-1] == "send_frame"):
+                sends.append(call)
+            elif method == "flush":
+                flushes.append(call)
+            if name is not None and "fsync" in name.rsplit(
+                    ".", 1)[-1]:
+                fsyncs.append(call)
+        # SOURCE order: _direct_calls walks an AST stack whose order
+        # is arbitrary — pairing must judge each write against its
+        # genuinely FIRST later send, or an unfsynced nearer send
+        # hides behind a safe farther one (a false negative in the
+        # exact hole this rule exists to close)
+        sends.sort(key=lambda c: c.lineno)
+        for w in writes:
+            for s in sends:
+                if s.lineno <= w.lineno:
+                    continue
+                ok = (any(w.lineno < f.lineno <= s.lineno
+                          for f in flushes)
+                      and any(w.lineno < y.lineno <= s.lineno
+                              for y in fsyncs))
+                if not ok:
+                    yield self.violation(
+                        ctx, s,
+                        "socket send after a WAL/file write with no "
+                        "flush+fsync between them — the ack can "
+                        "escape ahead of the record's durability, "
+                        "and a recovered coordinator would forget "
+                        "state a worker already observed; fsync "
+                        "before the send (wal.WriteAheadLog.append "
+                        "is the shape)")
+                break  # one finding per write: its FIRST later send
+
+
+RULES = (ClusterTransportDiscipline(), WalDurabilityDiscipline())

@@ -457,6 +457,15 @@ def main(argv=None):
                    help="local role: real worker processes (kill -9 is "
                         "the genuine article) or threads (same "
                         "protocol/sockets, fast for tests)")
+    p.add_argument("--coordinator-spawn", default="inproc",
+                   choices=["inproc", "process"],
+                   help="local role: run the coordinator in-process "
+                        "(a cluster:coordinator kill cell slams its "
+                        "sockets) or as a REAL subprocess (the kill "
+                        "is a genuine kill -9 of the control plane; "
+                        "the launcher respawns it on the same port "
+                        "and it recovers from the durable WAL — "
+                        "needs --checkpoint-dir)")
     p.add_argument("--connect", type=str, default=None,
                    metavar="HOST:PORT",
                    help="worker role: the coordinator's address")
@@ -505,8 +514,25 @@ def main(argv=None):
                    help="seconds of worker silence before the "
                         "coordinator declares it dead (EOF on its "
                         "connection is detected immediately)")
+    p.add_argument("--heartbeat-interval", type=float, default=0.5,
+                   help="seconds between worker liveness beats")
+    p.add_argument("--rpc-deadline", type=float, default=30.0,
+                   help="bound on any single blocking transport "
+                        "round trip")
+    p.add_argument("--reconnect-grace", type=float, default=1.0,
+                   help="seconds a connection's EOF leaves its slot "
+                        "SUSPECT before the death fires — the window "
+                        "a reconnecting worker's re-dial has to race "
+                        "the EOF sweep without burning a membership "
+                        "epoch")
     p.add_argument("--n-rows", type=int, default=4096,
                    help="training rows of the shared synthetic task")
+    p.add_argument("--train-json", type=str, default=None,
+                   metavar="JSON",
+                   help="coordinator role plumbing: the EXACT "
+                        "TrainTask as JSON (the local launcher's "
+                        "subprocess handoff — every field, not just "
+                        "--algo/--n-rows; overrides both)")
     p.add_argument("--deadline", type=float, default=600.0,
                    help="local/coordinator roles: give up if the run "
                         "is still incomplete after this many seconds")
@@ -530,7 +556,7 @@ def main(argv=None):
     p.add_argument("--workload", default="lr",
                    choices=["lr", "ssgd", "kmeans", "als",
                             "kmeans_stream", "pagerank_stream",
-                            "serve", "ssp"])
+                            "serve", "ssp", "cluster"])
     p.add_argument("--n-slices", type=int, default=0)
     _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=None,
@@ -538,6 +564,15 @@ def main(argv=None):
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--max-restarts", type=int, default=3,
                    help="restart budget for the chaos run")
+    p.add_argument("--spawn", default="thread",
+                   choices=["thread", "process"],
+                   help="cluster workload only: thread-mode workers "
+                        "(fast smoke — the bench fast path runs this) "
+                        "or real worker processes (a cluster:"
+                        "coordinator kill is then a mid-window kill "
+                        "of the in-process coordinator either way; "
+                        "the genuine subprocess kill -9 is 'tda "
+                        "cluster --coordinator-spawn process')")
     p.add_argument("--workdir", type=str, default=None,
                    help="checkpoint scratch directory (default: a "
                         "fresh temp dir, removed on success)")
@@ -675,7 +710,6 @@ def main(argv=None):
 
 def _run_cluster(args):
     """``tda cluster`` — the multi-process elastic runtime."""
-    import hashlib
     import json as _json
     import os
 
@@ -702,43 +736,66 @@ def _run_cluster(args):
              if not isinstance(v, list)}))
         return 0
     plan = args.fault_plan or os.environ.get("TDA_FAULT_PLAN") or None
+    train = (clus.TrainTask(**_json.loads(args.train_json))
+             if args.train_json
+             else clus.TrainTask(algo=args.algo, n_rows=args.n_rows))
     cfg = clus.ClusterConfig(
         n_slots=args.workers, n_windows=args.n_windows,
         staleness=spec.staleness, decay=spec.decay,
         ps_shards=args.ps_shards, host=args.host, port=args.port,
         heartbeat_timeout=args.heartbeat_timeout,
+        heartbeat_interval=args.heartbeat_interval,
+        rpc_deadline=args.rpc_deadline,
+        reconnect_grace=args.reconnect_grace,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
-        policy=args.policy, plan_spec=plan,
-        train=clus.TrainTask(algo=args.algo, n_rows=args.n_rows))
+        policy=args.policy, plan_spec=plan, train=train)
     if args.role == "coordinator":
         coord = clus.Coordinator(cfg).start()
         print(f"cluster_coordinator: listening on "
               f"{cfg.host}:{coord.port}", flush=True)
-        res = coord.wait(timeout=args.deadline)
+        coord.wait(timeout=args.deadline)
+        # linger briefly for the workers' byes (their stats ride
+        # them): done fires at the final commit, a breath before the
+        # last deferred acks + byes drain; the result snapshots AFTER
+        coord_deadline = time.monotonic() + 10.0
+        while time.monotonic() < coord_deadline and any(
+                st.status == "active"
+                for st in coord.slots.values()):
+            time.sleep(0.05)
+        res = coord.result()
         coord.stop()
     else:
         # (main() already pointed this process's telemetry at
         # DIR/coordinator; spawned workers get DIR/worker-N)
         res = clus.run_local_cluster(
-            cfg, spawn=args.spawn, rejoin_after=args.rejoin_after,
+            cfg, spawn=args.spawn,
+            coordinator_spawn=args.coordinator_spawn,
+            rejoin_after=args.rejoin_after,
             telemetry_dir=args.telemetry_dir, timeout=args.deadline,
             logger=err)
-    seq = _json.dumps(
-        [res["merge_sequence"], res["membership_sequence"]],
-        default=int)
+    from tpu_distalg.cluster.local import event_digest
+
     # machine-readable tail line: the replay acceptance compares the
-    # event digest of two runs under the same plan
+    # event digest of two runs under the same plan. A subprocess
+    # coordinator already digested its own sequences (its result line
+    # is what the launcher parsed) — pass that through verbatim.
     print("cluster_result: " + _json.dumps({
         "accuracy": round(res["accuracy"], 6),
         "version": res["version"],
         "gen": res["gen"],
-        "merges": len(res["merge_sequence"]),
+        "merges": res.get("merges",
+                          len(res.get("merge_sequence", ()))),
         "respawns": res.get("respawns", 0),
         "restarts": res.get("restarts", 0),
-        "event_digest":
-            hashlib.sha256(seq.encode()).hexdigest()[:16],
-    }))
+        "recoveries": res.get(
+            "coordinator_recoveries",
+            1 if res.get("recovered") else 0),
+        "recovery_ms": res.get("recovery_ms", []),
+        "wal_records_replayed": res.get("wal_records_replayed", 0),
+        "event_digest": res.get("event_digest",
+                                None) or event_digest(res),
+    }, default=float))
     return 0
 
 
@@ -1205,6 +1262,7 @@ def _dispatch(args, jax):
                 n_iterations=args.n_iterations,
                 checkpoint_every=args.checkpoint_every,
                 max_restarts=args.max_restarts,
+                spawn=args.spawn,
                 logger=lambda m: print(f"[chaos] {m}"))
         finally:
             if made_tmp:

@@ -17,13 +17,14 @@ See ``docs/ARCHITECTURE.md`` ("Multi-process elastic runtime") and
 ``tda cluster --help``.
 """
 
-from tpu_distalg.cluster import ps, transport
+from tpu_distalg.cluster import ps, transport, wal
 from tpu_distalg.cluster.coordinator import (
     ClusterAborted,
     ClusterConfig,
     Coordinator,
     TrainTask,
     center_accuracy,
+    compile_coordinator_schedule,
 )
 from tpu_distalg.cluster.local import run_local_cluster
 from tpu_distalg.cluster.worker import (
@@ -38,10 +39,12 @@ __all__ = [
     "Coordinator",
     "TrainTask",
     "center_accuracy",
+    "compile_coordinator_schedule",
     "compile_worker_schedule",
     "ps",
     "run_local_cluster",
     "run_worker",
     "strip_kills",
     "transport",
+    "wal",
 ]

@@ -19,11 +19,26 @@ identical. ``policy='restart'`` instead respawns the WHOLE cluster
 from the durable checkpoint on any death: the gang-scheduled
 BSP-restart baseline the bench's elastic-speedup ratio measures
 against.
+
+COORDINATOR supervision (crash tolerance): a ``cluster:coordinator``
+kill cell in the plan kills the coordinator itself mid-window — in
+thread/inproc mode the injected ``die`` slams its listener and every
+connection (the SIGKILL observable), with ``coordinator_spawn=
+'process'`` the coordinator is a real subprocess that genuinely
+``kill -9``\\ s itself. Either way the launcher detects the death,
+respawns the coordinator ON THE SAME PORT under the coordinator-kill-
+stripped plan, and the new incarnation recovers from the durable WAL
+(``cluster/wal.py``) while the surviving workers reconnect and resume
+their incarnations — no membership epoch burns, no progress is lost,
+and the measured ``detect -> recover -> first recommitted window``
+latency lands in the result as ``recovery_ms`` (the
+``cluster_coordinator_recovery_ms`` bench metric).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import subprocess
 import sys
@@ -32,16 +47,50 @@ import time
 
 import numpy as np
 
+from tpu_distalg.cluster import transport
 from tpu_distalg.cluster import worker as workermod
 from tpu_distalg.cluster.coordinator import (
+    COORD_KILL,
     ClusterAborted,
     ClusterConfig,
     Coordinator,
+    compile_coordinator_schedule,
 )
 from tpu_distalg.faults import registry as fregistry
+from tpu_distalg.telemetry import events as tevents
 
 #: windows a killed slot stays away before its replacement is admitted
 DEFAULT_REJOIN_AFTER = 3
+
+
+def _record_recovery(recovery_ms: list, t_detect: float,
+                     recommit_at: float) -> float:
+    """Close one detect→recover→first-recommitted-window measurement:
+    append the span and emit the counter + running-median gauge. ONE
+    spelling, shared by the inproc and subprocess-coordinator
+    supervisors, so the recovery telemetry's shape cannot drift
+    between the two."""
+    ms = (recommit_at - t_detect) * 1e3
+    recovery_ms.append(round(ms, 3))
+    tevents.counter("cluster.recovery_ms", int(round(ms)))
+    tevents.gauge(
+        "cluster.recovery_ms_p50",
+        round(float(np.percentile(recovery_ms, 50)), 3))
+    tevents.emit("cluster_recovery_measured", ms=round(ms, 3),
+                 recoveries=len(recovery_ms))
+    return ms
+
+
+def event_digest(result: dict) -> str:
+    """The 16-hex-char fingerprint of a run's merge + membership
+    sequences — what the CLI's ``cluster_result:`` tail line prints
+    and the replay/chaos acceptances compare (ONE spelling, so the
+    two can never drift)."""
+    import hashlib
+
+    seq = json.dumps([result["merge_sequence"],
+                      result["membership_sequence"]], default=int)
+    return hashlib.sha256(seq.encode()).hexdigest()[:16]
 
 
 class _ThreadWorker:
@@ -126,7 +175,72 @@ def _spawn_process_worker(host, port, slot, *, plan_spec,
         stderr=subprocess.DEVNULL)
 
 
+class _CoordSupervisor:
+    """The in-process coordinator under launcher supervision: builds
+    it with the thread-mode ``die`` hook (a kill cell slams the
+    listener and every connection — the SIGKILL observable), detects
+    the death, respawns ON THE SAME PORT under the coordinator-kill-
+    stripped plan (the new incarnation recovers from the WAL), and
+    measures ``detect -> recover -> first recommitted window``."""
+
+    def __init__(self, config: ClusterConfig, log):
+        self.config = config
+        self.log = log
+        self.coord = Coordinator(
+            config, die=lambda c: c.slam()).start()
+        self.port = self.coord.port
+        self.recoveries = 0
+        self.recovery_ms: list[float] = []
+        self.wal_records_replayed = 0
+        self._pending: float | None = None   # detect time of an
+        #                                      unclosed measurement
+
+    def check(self) -> None:
+        """One supervision tick: respawn a killed coordinator, close
+        out a pending recovery measurement once the first window past
+        the death point recommits (the coordinator records that
+        commit's monotonic timestamp itself, so a supervision tick
+        landing late — or only at completion — still measures the
+        true detect→recover→first-recommitted-window span)."""
+        if self.coord.killed and self._pending is None:
+            t_detect = time.monotonic()
+            v_death = self.coord.version
+            self.log(f"[cluster] coordinator died on schedule at "
+                     f"version {v_death}; respawning on port "
+                     f"{self.port} (WAL recovery)")
+            # the transient fault already fired: the recovered
+            # incarnation runs coordinator-kill-free
+            self.config = dataclasses.replace(
+                self.config, port=self.port,
+                plan_spec=workermod.strip_kills(
+                    self.config.plan_spec,
+                    points=("cluster:coordinator",)))
+            self.coord = Coordinator(
+                self.config, die=lambda c: c.slam()).start()
+            self.recoveries += 1
+            self.wal_records_replayed += \
+                self.coord.wal_records_replayed
+            self._pending = t_detect
+        if self._pending is not None and \
+                self.coord.first_recommit_at is not None:
+            _record_recovery(self.recovery_ms, self._pending,
+                             self.coord.first_recommit_at)
+            self._pending = None
+
+    def stop(self) -> None:
+        self.coord.stop()
+
+    def bookkeeping(self) -> dict:
+        self.check()   # close out a measurement the last poll missed
+        return {
+            "coordinator_recoveries": self.recoveries,
+            "recovery_ms": list(self.recovery_ms),
+            "wal_records_replayed": self.wal_records_replayed,
+        }
+
+
 def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
+                      coordinator_spawn: str = "inproc",
                       respawn: bool = True,
                       rejoin_after: int = DEFAULT_REJOIN_AFTER,
                       telemetry_dir: str | None = None,
@@ -134,7 +248,9 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
                       logger=None) -> dict:
     """Run one full cluster training locally; returns the
     coordinator's result dict plus launcher bookkeeping
-    (``restarts``, ``respawns``, ``wall_seconds``).
+    (``restarts``, ``respawns``, ``wall_seconds``, and — when the
+    plan kills the coordinator — ``coordinator_recoveries`` /
+    ``recovery_ms`` / ``wal_records_replayed``).
 
     * ``policy='elastic'`` (config): a killed worker's slot is
       respawned once (``respawn=True``) under the kill-stripped plan,
@@ -144,14 +260,42 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
     * ``policy='restart'``: any death aborts; the WHOLE cluster
       respawns from the checkpoint until the run completes — the
       measured BSP-restart baseline.
+    * a ``cluster:coordinator`` kill cell kills the COORDINATOR
+      mid-window; the launcher respawns it on the same port and the
+      WAL recovery + worker reconnects make the completed run
+      bitwise-identical to the undisturbed one. Requires a
+      ``checkpoint_dir`` (the WAL lives under it).
+      ``coordinator_spawn='process'`` runs the coordinator as a real
+      subprocess (``tda cluster --role coordinator``) so the kill is
+      a genuine ``kill -9``.
     """
     log = logger or (lambda m: None)
+    coord_sched = compile_coordinator_schedule(
+        config.n_windows,
+        plan=(fregistry.FaultPlan.parse(config.plan_spec)
+              if config.plan_spec else None))
+    if (coord_sched == COORD_KILL).any() and not config.checkpoint_dir:
+        raise ValueError(
+            "a cluster:coordinator kill plan needs a checkpoint_dir: "
+            "the durable WAL (and the center checkpoints it sits on) "
+            "live under it — without one there is nothing to recover "
+            "from")
+    if coordinator_spawn == "process":
+        return _run_process_coordinator(
+            config, spawn=spawn, respawn=respawn,
+            rejoin_after=rejoin_after, telemetry_dir=telemetry_dir,
+            timeout=timeout, log=log)
+    if coordinator_spawn != "inproc":
+        raise ValueError(
+            f"unknown coordinator_spawn {coordinator_spawn!r}: "
+            f"'inproc' (thread-mode die hook) or 'process' (real "
+            f"subprocess, genuine kill -9)")
     t0 = time.monotonic()
     plan_spec = config.plan_spec
     restarts = 0
     while True:
-        coord = Coordinator(config).start()
-        host, port = config.host, coord.port
+        sup = _CoordSupervisor(config, log)
+        host, port = config.host, sup.port
         schedule = workermod.compile_worker_schedule(
             config.n_windows, config.n_slots,
             plan=(fregistry.FaultPlan.parse(plan_spec)
@@ -164,8 +308,9 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
         if config.policy == "elastic" and respawn:
             # pin every replacement's admission window up front: the
             # event sequence becomes a pure function of the plan
+            # (durable — a recovered coordinator keeps the hold)
             for slot, w_kill in sorted(kill_cells.items()):
-                coord.hold_admission(
+                sup.coord.hold_admission(
                     min(w_kill + rejoin_after, config.n_windows - 1),
                     config.n_slots)
         workers = {}
@@ -178,7 +323,7 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
             if config.policy == "elastic" and respawn else {})
         respawned: list[int] = []
         try:
-            result = _supervise(coord, workers, pending_respawn,
+            result = _supervise(sup, workers, pending_respawn,
                                 spawn, host, port, telemetry_dir,
                                 timeout, log, respawned)
             result["restarts"] = restarts
@@ -187,13 +332,18 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
             # bench's did-the-kill-really-fire guard reads this
             result["respawns"] = len(respawned)
             result["wall_seconds"] = round(time.monotonic() - t0, 3)
+            result.update(sup.bookkeeping())
             return result
         except ClusterAborted as e:
             restarts += 1
             log(f"[cluster] aborted ({e}); restart policy respawns "
                 f"the whole cluster (restart {restarts})")
-            coord.stop()
+            # reap BEFORE stopping: the aborted coordinator keeps
+            # answering status frames with restart=True, so surviving
+            # workers exit their loops gracefully instead of entering
+            # their reconnect retry budgets against a closed port
             _reap(workers, spawn)
+            sup.stop()
             # the transient fault already fired: the respawned job
             # runs kill-free (worker.strip_kills), like a real
             # executor loss
@@ -203,7 +353,7 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
                 raise TimeoutError(
                     f"restart-policy run exceeded {timeout}s") from e
         finally:
-            coord.stop()
+            sup.stop()
 
 
 def _start(spawn, host, port, slot, *, telemetry_dir,
@@ -219,6 +369,27 @@ def _start(spawn, host, port, slot, *, telemetry_dir,
 
 def _alive(h, spawn):
     return (h.poll() is None) if spawn == "process" else h.alive
+
+
+def _respawn_dead_workers(workers, pending_respawn, spawn, host,
+                          port, telemetry_dir, respawned, log):
+    """One supervision sweep of the worker slots: a scheduled kill's
+    dead handle is replaced ONCE, its admission pinned to the
+    plan-determined window (a rejoiner never re-executes windows
+    before its admission, so the old kill cell cannot re-fire).
+    Shared by the inproc and subprocess-coordinator supervisors so
+    the two loops cannot drift."""
+    for slot in list(pending_respawn):
+        h = workers.get(slot)
+        if h is not None and _alive(h, spawn):
+            continue
+        admit_at = pending_respawn.pop(slot)
+        respawned.append(slot)
+        log(f"[cluster] worker {slot} died on schedule; "
+            f"respawning (rejoin at window {admit_at})")
+        workers[slot] = _start(
+            spawn, host, port, slot, telemetry_dir=telemetry_dir,
+            rejoin=True, admit_at=admit_at)
 
 
 def _reap(workers, spawn):
@@ -237,44 +408,267 @@ def _reap(workers, spawn):
             h.join(timeout=30)
 
 
-def _supervise(coord, workers, pending_respawn, spawn, host, port,
+def _supervise(sup, workers, pending_respawn, spawn, host, port,
                telemetry_dir, timeout, log, respawned):
-    """Drive one incarnation to completion: wait on the coordinator,
-    respawning killed slots (elastic) as their deaths surface.
-    ``pending_respawn`` maps slot -> pinned admission window;
-    ``respawned`` collects the slots actually replaced."""
+    """Drive one incarnation to completion: wait on the (supervised)
+    coordinator, respawning killed slots (elastic) — and a killed
+    COORDINATOR — as their deaths surface. ``pending_respawn`` maps
+    slot -> pinned admission window; ``respawned`` collects the slots
+    actually replaced."""
     deadline = time.monotonic() + timeout
     while True:
         try:
             # short wait slices: a scheduled kill's respawn latency is
             # bounded by this poll, and it sits on the elastic arm's
             # measured wall clock
-            coord.wait(timeout=0.05)
+            sup.coord.wait(timeout=0.05)
             _reap(workers, spawn)
             # re-snapshot AFTER the workers' byes have landed, so the
             # result carries their reported stats
-            return coord.result()
+            return sup.coord.result()
         except TimeoutError:
             if time.monotonic() > deadline:
-                coord.stop()
+                sup.stop()
                 _reap(workers, spawn)
                 raise TimeoutError(
                     f"cluster run still incomplete after {timeout}s "
-                    f"(version {coord.version}/{coord.cfg.n_windows})"
-                    ) from None
-        for slot in list(pending_respawn):
-            h = workers.get(slot)
-            if h is not None and _alive(h, spawn):
-                continue
-            # the kill landed; respawn the slot ONCE, its admission
-            # pinned to the plan-determined window (a rejoiner never
-            # re-executes windows before its admission, so the old
-            # kill cell cannot re-fire)
-            admit_at = pending_respawn.pop(slot)
-            respawned.append(slot)
-            log(f"[cluster] worker {slot} died on schedule; "
-                f"respawning (rejoin at window {admit_at})")
-            workers[slot] = _start(
-                spawn, host, port, slot,
-                telemetry_dir=telemetry_dir, rejoin=True,
-                admit_at=admit_at)
+                    f"(version {sup.coord.version}/"
+                    f"{sup.coord.cfg.n_windows})") from None
+        sup.check()   # coordinator death -> respawn + WAL recovery
+        _respawn_dead_workers(workers, pending_respawn, spawn, host,
+                              port, telemetry_dir, respawned, log)
+
+
+# --------------------------------------------- subprocess coordinator
+
+
+class _ProcCoordinator:
+    """A REAL coordinator process (``tda cluster --role coordinator``)
+    — the seeded ``cluster:coordinator`` kill is a genuine
+    ``kill -9`` here. Stdout is drained on a thread; the launcher
+    parses the ``listening on`` line for the port and the final
+    ``cluster_result:`` line for the result."""
+
+    def __init__(self, config: ClusterConfig, telemetry_dir, *,
+                 port: int = 0):
+        env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", "cpu"))
+        env.pop("TDA_FAULT_PLAN", None)
+        cmd = [sys.executable, "-m", "tpu_distalg.cli", "cluster",
+               "--role", "coordinator",
+               "--host", config.host, "--port", str(port),
+               "--workers", str(config.n_slots),
+               "--n-windows", str(config.n_windows),
+               "--sync",
+               f"ssp:{config.staleness}:{config.decay:g}",
+               "--ps-shards", str(config.ps_shards),
+               "--heartbeat-timeout", str(config.heartbeat_timeout),
+               "--heartbeat-interval",
+               str(config.heartbeat_interval),
+               "--rpc-deadline", str(config.rpc_deadline),
+               "--reconnect-grace", str(config.reconnect_grace),
+               # the EXACT TrainTask, every field — workers take the
+               # task from the coordinator's welcome, so a lossy
+               # handoff here would silently train a different task
+               # than the caller configured
+               "--train-json", json.dumps(config.train.as_meta()),
+               "--policy", config.policy]
+        if config.checkpoint_dir:
+            cmd += ["--checkpoint-dir", config.checkpoint_dir,
+                    "--checkpoint-every",
+                    str(config.checkpoint_every)]
+        if config.plan_spec:
+            cmd += ["--fault-plan", config.plan_spec]
+        if telemetry_dir:
+            cmd += ["--telemetry-dir",
+                    os.path.join(telemetry_dir, "coordinator")]
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1)
+        self.lines: list[str] = []
+        self._t = threading.Thread(target=self._drain,
+                                   name="tda-coord-stdout",
+                                   daemon=True)
+        self._t.start()
+        self.port = self._await_port()
+
+    def _drain(self):
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip("\n"))
+
+    def _await_port(self, timeout: float = 90.0) -> int:
+        deadline = time.monotonic() + timeout
+        prefix = "cluster_coordinator: listening on "
+        while time.monotonic() < deadline:
+            for line in list(self.lines):
+                if line.startswith(prefix):
+                    return int(line[len(prefix):].rsplit(":", 1)[1])
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator process exited rc="
+                    f"{self.proc.returncode} before binding:\n"
+                    + "\n".join(self.lines[-20:]))
+            time.sleep(0.02)
+        raise TimeoutError("coordinator process never reported its "
+                           "port")
+
+    def result_line(self) -> dict:
+        prefix = "cluster_result: "
+        for line in reversed(self.lines):
+            if line.startswith(prefix):
+                return json.loads(line[len(prefix):])
+        raise RuntimeError(
+            "coordinator process exited without a cluster_result "
+            "line:\n" + "\n".join(self.lines[-20:]))
+
+
+def _tcp_status(host, port, *, deadline: float = 2.0):
+    """One status poll over the wire (the launcher's liveness /
+    recovery probe for a subprocess coordinator); ``None`` when the
+    coordinator is unreachable."""
+    try:
+        sock = transport.connect(host, port, deadline=deadline,
+                                 attempts=1)
+    except transport.TransportError:
+        return None
+    try:
+        _, m, _ = transport.request(sock, "poll", {},
+                                    deadline=deadline)
+        return m
+    except transport.TransportError:
+        return None
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _tcp_hold(host, port, window, n_active, *,
+              deadline: float = 5.0) -> None:
+    """Pin an admission hold over the wire (the subprocess-coordinator
+    spelling of ``Coordinator.hold_admission``)."""
+    sock = transport.connect(host, port, deadline=deadline)
+    try:
+        transport.request(sock, "hold",
+                          {"window": window, "n_active": n_active},
+                          deadline=deadline)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _run_process_coordinator(config: ClusterConfig, *, spawn,
+                             respawn, rejoin_after, telemetry_dir,
+                             timeout, log) -> dict:
+    """The subprocess-coordinator cluster: the coordinator is a real
+    OS process, so a ``cluster:coordinator`` kill cell is a genuine
+    mid-window ``kill -9`` of the control plane (workers honor the
+    caller's ``spawn`` — processes for the full acceptance, threads
+    for a faster genuine-coordinator-kill run). The launcher
+    respawns it on the same port under the coordinator-kill-stripped
+    plan; recovery (WAL replay + worker reconnects) is measured over
+    TCP status polls. Elastic policy only — the restart baseline has
+    an in-process launcher already."""
+    if config.policy != "elastic":
+        raise ValueError(
+            "coordinator_spawn='process' supports policy='elastic' "
+            "only (the restart baseline is an in-process launcher "
+            "measurement)")
+    t0 = time.monotonic()
+    pc = _ProcCoordinator(config, telemetry_dir)
+    host, port = config.host, pc.port
+    schedule = workermod.compile_worker_schedule(
+        config.n_windows, config.n_slots,
+        plan=(fregistry.FaultPlan.parse(config.plan_spec)
+              if config.plan_spec else None))
+    kill_cells: dict[int, int] = {}
+    for w, slot in zip(*np.nonzero(schedule == workermod.KILL)):
+        kill_cells.setdefault(int(slot), int(w))
+    coord_kill_expected = (compile_coordinator_schedule(
+        config.n_windows,
+        plan=(fregistry.FaultPlan.parse(config.plan_spec)
+              if config.plan_spec else None)) == COORD_KILL).any()
+    pending_respawn = {}
+    if respawn:
+        for slot, w_kill in sorted(kill_cells.items()):
+            _tcp_hold(host, port,
+                      min(w_kill + rejoin_after,
+                          config.n_windows - 1), config.n_slots)
+        pending_respawn = {
+            slot: min(w + rejoin_after, config.n_windows - 1)
+            for slot, w in kill_cells.items()}
+    workers = {slot: _start(spawn, host, port, slot,
+                            telemetry_dir=telemetry_dir)
+               for slot in range(config.n_slots)}
+    respawned: list[int] = []
+    recoveries = 0
+    recovery_ms: list[float] = []
+    pending_rec: float | None = None   # detect time
+    last_version = 0
+    deadline = t0 + timeout
+    try:
+        while True:
+            rc = pc.proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    break                       # clean completion
+                if not coord_kill_expected or recoveries >= 1:
+                    raise RuntimeError(
+                        f"coordinator process died rc={rc} with no "
+                        f"scheduled kill left — a real failure:\n"
+                        + "\n".join(pc.lines[-20:]))
+                t_detect = time.monotonic()
+                log(f"[cluster] coordinator killed (rc={rc}); "
+                    f"respawning on port {port} (WAL recovery)")
+                config = dataclasses.replace(
+                    config, plan_spec=workermod.strip_kills(
+                        config.plan_spec,
+                        points=("cluster:coordinator",)))
+                pc = _ProcCoordinator(config, telemetry_dir,
+                                      port=port)
+                recoveries += 1
+                pending_rec = t_detect
+            status = _tcp_status(host, port)
+            if status is not None:
+                last_version = max(last_version,
+                                   int(status.get("version", 0)))
+                recommit_at = status.get("recommit_at")
+                if pending_rec is not None and \
+                        recommit_at is not None:
+                    # the recovered coordinator stamps its own first
+                    # post-recovery commit (CLOCK_MONOTONIC is
+                    # machine-wide), so the span is the true detect->
+                    # recover->first-recommitted-window — not "first
+                    # status poll after replay"
+                    _record_recovery(recovery_ms, pending_rec,
+                                     float(recommit_at))
+                    pending_rec = None
+            _respawn_dead_workers(workers, pending_respawn,
+                                  spawn, host, port,
+                                  telemetry_dir, respawned, log)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"cluster run still incomplete after {timeout}s "
+                    f"(version {last_version}/{config.n_windows})")
+            time.sleep(0.05)
+    finally:
+        if pc.proc.poll() is None and time.monotonic() > deadline:
+            pc.proc.kill()
+        _reap(workers, spawn)
+    pc.proc.wait(timeout=30)
+    if pending_rec is not None:
+        # the run completed before a status poll caught the recommit:
+        # completion bounds it — record the (over-estimating) span
+        # rather than dropping the observation
+        _record_recovery(recovery_ms, pending_rec,
+                         time.monotonic())
+    result = pc.result_line()
+    result["restarts"] = 0
+    result["respawns"] = len(respawned)
+    result["wall_seconds"] = round(time.monotonic() - t0, 3)
+    result["coordinator_recoveries"] = recoveries
+    result["recovery_ms"] = recovery_ms
+    return result

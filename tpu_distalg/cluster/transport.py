@@ -86,6 +86,19 @@ class FrameTooLarge(TransportError):
     """A length prefix past the configured ceiling."""
 
 
+def _inject_rpc() -> None:
+    """The ``cluster:rpc`` fault seam, folded into the transport's
+    failure taxonomy: an injected ``oserror`` IS a torn connection,
+    so it must surface as :class:`TransportClosed` — the error every
+    handler/reconnect path already rides — not as a raw ``OSError``
+    that would skewer a coordinator handler thread."""
+    try:
+        faults.inject("cluster:rpc")
+    except OSError as e:
+        raise TransportClosed(
+            f"injected torn connection: {e}") from e
+
+
 def _check_dtype(dt: np.dtype) -> np.dtype:
     dt = np.dtype(dt)
     if dt.kind not in SAFE_DTYPE_KINDS:
@@ -128,10 +141,10 @@ def send_frame(sock: socket.socket, kind: str,
                ) -> None:
     """Frame and send one message; ``deadline`` bounds the whole send
     (a full peer socket buffer must not wedge the sender forever)."""
-    faults.inject("cluster:rpc")
+    _inject_rpc()
     buf = encode_frame(kind, meta, arrays)
-    sock.settimeout(deadline)
     try:
+        sock.settimeout(deadline)
         sock.sendall(buf)
     except socket.timeout as e:
         raise TransportTimeout(
@@ -174,12 +187,37 @@ def _recv_exact(sock: socket.socket, n: int, deadline_at: float,
     return b"".join(parts)
 
 
+def parse_payload(header: bytes, body: bytes):
+    """Decode a frame's header+body (CRC already verified) into
+    ``(kind, meta, arrays)`` — shared by :func:`recv_frame` and the
+    WAL's file reader (``cluster/wal.py``), so the wire format and the
+    durable-record format can never drift."""
+    try:
+        doc = json.loads(header)
+    except json.JSONDecodeError as e:
+        raise TransportError(f"undecodable frame header: {e}") from e
+    arrays, off = {}, 0
+    for spec in doc.get("arrays", ()):
+        dt = _check_dtype(np.dtype(spec["d"]))
+        shape = tuple(int(x) for x in spec["s"])
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if off + nbytes > len(body):
+            raise TransportError(
+                f"array {spec['n']!r} ({shape}, {dt}) overruns the "
+                f"frame body ({off + nbytes} > {len(body)})")
+        arrays[spec["n"]] = np.frombuffer(
+            body, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
+            offset=off).reshape(shape).copy()
+        off += nbytes
+    return doc.get("k", "?"), doc.get("meta", {}), arrays
+
+
 def recv_frame(sock: socket.socket, *,
                deadline: float = DEFAULT_DEADLINE_SECONDS,
                max_frame: int = DEFAULT_MAX_FRAME_BYTES):
     """Receive one frame -> ``(kind, meta, arrays)`` with every
     blocking read bounded by ``deadline`` seconds from entry."""
-    faults.inject("cluster:rpc")
+    _inject_rpc()
     deadline_at = time.monotonic() + deadline
     raw = _recv_exact(sock, _PREFIX.size, deadline_at, "frame prefix")
     magic, hlen, blen, crc = _PREFIX.unpack(raw)
@@ -203,24 +241,7 @@ def recv_frame(sock: socket.socket, *,
         raise TransportError(
             f"frame CRC mismatch (stored {crc:#010x}, computed "
             f"{got_crc:#010x}) — corrupted in flight")
-    try:
-        doc = json.loads(header)
-    except json.JSONDecodeError as e:
-        raise TransportError(f"undecodable frame header: {e}") from e
-    arrays, off = {}, 0
-    for spec in doc.get("arrays", ()):
-        dt = _check_dtype(np.dtype(spec["d"]))
-        shape = tuple(int(x) for x in spec["s"])
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
-        if off + nbytes > len(body):
-            raise TransportError(
-                f"array {spec['n']!r} ({shape}, {dt}) overruns the "
-                f"frame body ({off + nbytes} > {len(body)})")
-        arrays[spec["n"]] = np.frombuffer(
-            body, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
-            offset=off).reshape(shape).copy()
-        off += nbytes
-    return doc.get("k", "?"), doc.get("meta", {}), arrays
+    return parse_payload(header, body)
 
 
 def connect(host: str, port: int, *,

@@ -31,7 +31,27 @@ what makes a chaos run replayable. Cell kinds:
 Liveness: a ``telemetry/heartbeat.py`` ``Heartbeat`` thread beats over
 a SECOND connection (``emit_fn`` both records the event and sends the
 frame), so a worker wedged in compute is still visibly alive and a
-partitioned one goes visibly silent.
+partitioned one goes visibly silent. The beat loop survives transient
+send failures: a broken heartbeat connection is re-dialed with a
+short bounded retry (``cluster.heartbeat_retries``) instead of
+leaving the socket dead while the main loop lives.
+
+RECONNECT (coordinator crash tolerance): ``TransportClosed``/
+``TransportTimeout`` on the control connection no longer kills the
+worker. :class:`_Link` wraps every control-plane round trip in a
+bounded retry/backoff/jitter loop (``telemetry.supervisor.supervised``
+— the same generalized core behind backend init and checkpoint
+writes): it re-dials, re-presents its slot + incarnation token
+(``resume`` join), and re-sends the request. A recovered coordinator
+re-admits a matching incarnation WITHOUT burning a membership epoch;
+a push whose window was committed before the crash (the ack died with
+the coordinator) is deduped by the WAL's commit digest, and a push
+whose window was rolled back simply re-delivers — either way the
+worker cannot tell a recovered coordinator from one that never died,
+which is the whole determinism story. Only if the coordinator
+declared this incarnation dead during the outage does the worker get
+a FRESH admission (a ``reset``): it adopts the new center at the new
+admission window, exactly like a replacement process would.
 """
 
 from __future__ import annotations
@@ -48,6 +68,7 @@ from tpu_distalg.faults import registry as fregistry
 from tpu_distalg.parallel import ssp as pssp
 from tpu_distalg.telemetry import events as tevents
 from tpu_distalg.telemetry import heartbeat as theartbeat
+from tpu_distalg.telemetry.supervisor import supervised
 
 #: per-slot sampling-seed stride: slots draw independent minibatches
 SLOT_SEED_STRIDE = 1_000_003
@@ -57,6 +78,193 @@ GATE_POLL_SECONDS = 0.02
 
 #: schedule cell code for a kill (straggle cells hold their +units)
 KILL = -1
+
+#: control-connection reconnect budget: retries × capped backoff must
+#: comfortably cover a coordinator respawn (process spawn + checkpoint
+#: restore + WAL replay + bind) — exhaustion is a real outage
+RECONNECT_RETRIES = 20
+RECONNECT_BACKOFF_SECONDS = 0.1
+RECONNECT_BACKOFF_CAP_SECONDS = 1.0
+RECONNECT_JITTER = 0.25
+
+
+class _Link:
+    """The worker's control connection with crash-tolerant round
+    trips: every request retries through re-dial + resume-join on a
+    closed/timed-out transport, with bounded exponential backoff +
+    jitter. A resume that comes back as a FRESH admission (the
+    coordinator declared this incarnation dead during the outage)
+    surfaces as a synthetic ``("reset", welcome, center)`` reply the
+    main loop adopts like a new join."""
+
+    def __init__(self, host, port, sock, connect, ident, rpc_deadline,
+                 stats, log):
+        self.host, self.port = host, port
+        self.sock = sock
+        self.connect = connect
+        self.ident = ident          # shared with the caller: a fresh
+        #                             admission swaps the token in place
+        self.rpc_deadline = rpc_deadline
+        self.stats = stats
+        self.log = log
+        self._pending_reset = None
+
+    def drop(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _resume(self, *, dial_attempts: int = 200,
+                resume_only: bool = False):
+        """Re-dial and re-present the incarnation token. Sets
+        ``_pending_reset`` when the coordinator hands out a fresh
+        admission instead of a resume; ``resume_only`` forbids that
+        fallback (the bye's mode — a dead incarnation's farewell must
+        not be answered with a GHOST admission nobody will drive)."""
+        # fine-grained dial: the recovery metric is detect→recover→
+        # first-recommitted-window, and a coarse retry sleep here
+        # would put its floor at the sleep, not at the real respawn
+        sock = self.connect(self.host, self.port,
+                            attempts=dial_attempts,
+                            retry_sleep=0.05)
+        try:
+            k, m, arrs = transport.request(
+                sock, "join",
+                {"slot": self.ident["slot"], "inc": self.ident["inc"],
+                 "resume": True, "rejoin": True,
+                 "resume_only": resume_only},
+                deadline=self.rpc_deadline)
+        except transport.TransportError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        if k != "welcome":
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise transport.TransportClosed(
+                f"resume-join rejected: {m.get('error', k)}")
+        self.sock = sock
+        self.stats["reconnects"] += 1
+        tevents.counter("cluster.reconnects")
+        tevents.emit("cluster_worker_reconnect",
+                     slot=self.ident["slot"],
+                     resumed=bool(m.get("resume")))
+        if m.get("resume"):
+            return
+        # fencing moved on: fresh incarnation, fresh admission — the
+        # old incarnation's unpushed work is dropped, like a dead
+        # worker's would be
+        self.ident["inc"] = int(m["incarnation"])
+        self.stats["readmissions"] += 1
+        tevents.counter("cluster.readmissions")
+        self._pending_reset = (dict(m), dict(arrs))
+
+    def request(self, kind, meta, arrays=None, *, deadline=None,
+                retries=RECONNECT_RETRIES):
+        """One crash-tolerant round trip; may return the synthetic
+        ``reset`` reply instead of the requested one. ``retries``
+        trims the whole budget for best-effort frames — the re-dial
+        inside the retry shrinks with it, so a bye against a
+        coordinator that already exited fails in seconds, not
+        minutes — and a trimmed-budget frame is also RESUME-ONLY (a
+        farewell must never be answered with a fresh admission)."""
+        deadline = deadline if deadline is not None \
+            else self.rpc_deadline
+        best_effort = retries < RECONNECT_RETRIES
+
+        def attempt():
+            if self.sock is None:
+                self._resume(
+                    dial_attempts=20 if best_effort else 200,
+                    resume_only=best_effort)
+                if self._pending_reset is not None:
+                    m, arrs = self._pending_reset
+                    self._pending_reset = None
+                    return ("reset", m, arrs)
+            try:
+                return transport.request(self.sock, kind, meta,
+                                         arrays, deadline=deadline)
+            except (transport.TransportClosed,
+                    transport.TransportTimeout):
+                self.drop()
+                raise
+
+        return supervised(
+            attempt, phase="cluster_rpc",
+            retries=retries,
+            backoff=RECONNECT_BACKOFF_SECONDS,
+            backoff_cap=RECONNECT_BACKOFF_CAP_SECONDS,
+            jitter=RECONNECT_JITTER,
+            retry_on=(transport.TransportClosed,
+                      transport.TransportTimeout),
+            event="cluster_reconnect",
+            failure_counter="cluster.rpc_failures",
+            log=self.log)
+
+
+class _HbLink:
+    """The heartbeat connection with transient-failure survival: a
+    failed beat drops + re-dials the socket with a short in-beat
+    retry and bumps ``cluster.heartbeat_retries`` — the beat thread
+    itself never dies of an I/O error (the main loop may be healthy
+    and compute-bound; a silently dead beat loop would get it
+    declared dead by the coordinator's heartbeat scan)."""
+
+    RETRIES = 2
+
+    def __init__(self, host, port, connect, ident, deadline, stats):
+        self.host, self.port = host, port
+        self.connect = connect
+        self.ident = ident
+        self.deadline = deadline
+        self.stats = stats
+        self.sock = None
+        self.lock = threading.Lock()
+
+    def _drop(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def beat(self) -> None:
+        with self.lock:
+            for attempt in range(self.RETRIES + 1):
+                try:
+                    if self.sock is None:
+                        # short-fused dial: a beat must not wedge the
+                        # beat thread for the full connect budget —
+                        # the NEXT interval retries anyway
+                        self.sock = self.connect(
+                            self.host, self.port, attempts=2,
+                            retry_sleep=0.05)
+                    transport.send_frame(self.sock, "beat",
+                                         dict(self.ident),
+                                         deadline=self.deadline)
+                    transport.recv_frame(self.sock,
+                                         deadline=self.deadline)
+                    return
+                except (transport.TransportError, OSError):
+                    self._drop()
+                    self.stats["heartbeat_retries"] += 1
+                    tevents.counter("cluster.heartbeat_retries")
+                    if attempt < self.RETRIES:
+                        time.sleep(0.05 * (attempt + 1))
+            # still down after the in-beat retries: stay alive — the
+            # next interval's beat re-dials again
+
+    def close(self):
+        with self.lock:
+            self._drop()
 
 
 class WorkerKilled(Exception):
@@ -96,17 +304,20 @@ def compile_worker_schedule(n_windows: int, n_slots: int, *,
     return out
 
 
-def strip_kills(plan_spec: str | None) -> str | None:
-    """The plan with its ``cluster:worker`` KILL rules removed — what a
+def strip_kills(plan_spec: str | None,
+                points: tuple[str, ...] = ("cluster:worker",)
+                ) -> str | None:
+    """The plan with its KILL rules at ``points`` removed — what a
     respawned incarnation runs under (the fault was transient: a
-    restarted executor re-dying on the same deterministic cell would
-    loop forever, in both the elastic and the restart-baseline arms)."""
+    restarted executor — or a recovered coordinator, with
+    ``points=('cluster:coordinator',)`` — re-dying on the same
+    deterministic cell would loop forever, in both the elastic and
+    the restart-baseline arms)."""
     if not plan_spec:
         return plan_spec
     plan = fregistry.FaultPlan.parse(plan_spec)
     rules = tuple(r for r in plan.rules
-                  if not (r.point == "cluster:worker"
-                          and r.kind == "kill"))
+                  if not (r.point in points and r.kind == "kill"))
     return fregistry.FaultPlan(seed=plan.seed, rules=rules).spec()
 
 
@@ -251,11 +462,29 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
     log = logger or (lambda m: None)
     die = die or _default_die
     connect = connect or transport.connect
-    sock = connect(host, port)
+    sock = None
+    last_err: Exception | None = None
     for attempt in range(80):
-        kind, meta, center = transport.request(
-            sock, "join",
-            {"slot": slot, "rejoin": rejoin, "admit_at": admit_at})
+        try:
+            if sock is None:
+                sock = connect(host, port)
+            kind, meta, center = transport.request(
+                sock, "join",
+                {"slot": slot, "rejoin": rejoin,
+                 "admit_at": admit_at})
+        except transport.TransportError as e:
+            # a torn dial/handshake (an rpc-storm fault, or the
+            # coordinator mid-recovery): re-dial, like every later
+            # round trip does through the link
+            last_err = e
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = None
+            time.sleep(0.25)
+            continue
         if kind == "welcome":
             break
         if "slots active" in str(meta.get("error", "")) \
@@ -269,10 +498,15 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
         sock.close()
         raise RuntimeError(
             f"join rejected: {meta.get('error', kind)}")
+    else:
+        raise transport.TransportClosed(
+            f"could not join the coordinator at {host}:{port} after "
+            f"80 attempts: {last_err}")
     slot = int(meta["slot"])
     inc = int(meta.get("incarnation", 0))
     # the fencing token: every frame this incarnation sends carries it,
-    # so a replacement can never be confused with its zombie
+    # so a replacement can never be confused with its zombie (the link
+    # shares this dict — a fresh re-admission swaps the token in place)
     ident = {"slot": slot, "inc": inc}
     s = int(meta["s"])
     n_windows = int(meta["n_windows"])
@@ -288,29 +522,30 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                  admit=meta["admit"], gen=meta["gen"])
     tevents.mark(f"cluster:worker{slot}", emit_event=False)
 
+    stats = {"pushes": 0, "skips": 0, "gated_ms": 0.0,
+             "push_pull_ms_total": 0.0, "push_pull_ms": [],
+             "ages": [], "windows": 0, "undelivered_windows": 0,
+             "reconnects": 0, "readmissions": 0,
+             "heartbeat_retries": 0}
+    link = _Link(host, port, sock, connect, ident, rpc_deadline,
+                 stats, log)
+
     # liveness: the shared Heartbeat thread, its emit_fn ALSO framing a
-    # beat to the coordinator — compute-bound windows stay visibly
-    # alive, a partition goes visibly silent
-    hb_sock = connect(host, port)
-    hb_lock = threading.Lock()
+    # beat to the coordinator over its own crash-tolerant link —
+    # compute-bound windows stay visibly alive, a partition goes
+    # visibly silent, and one broken beat never ends the loop
+    hb_link = _HbLink(host, port, connect, ident, rpc_deadline, stats)
 
     def hb_emit(ev, **fields):
         tevents.emit(ev, **fields)
-        if ev != "heartbeat":
-            return
-        with hb_lock:
-            transport.send_frame(hb_sock, "beat", dict(ident),
-                                 deadline=rpc_deadline)
-            transport.recv_frame(hb_sock, deadline=rpc_deadline)
+        if ev == "heartbeat":
+            hb_link.beat()
 
     hb = theartbeat.Heartbeat(
         interval=float(meta.get("heartbeat_interval", 0.5)),
         stall_after=None, emit_fn=hb_emit)
     hb.start()
 
-    stats = {"pushes": 0, "skips": 0, "gated_ms": 0.0,
-             "push_pull_ms_total": 0.0, "push_pull_ms": [],
-             "ages": [], "windows": 0, "undelivered_windows": 0}
     pending_windows = 0   # trained-but-not-yet-pushed (busy skips)
     version = int(meta["version"])
     w_base = np.asarray(center["w"], np.float32)
@@ -320,6 +555,38 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
     done = bool(meta.get("done"))
     restart = False
     killed = False
+
+    def adopt_reset(m, arrays):
+        """A fresh re-admission (the old incarnation was declared
+        dead during a coordinator outage): adopt the welcome like a
+        brand-new join — new admission window, the current center,
+        zero pending work."""
+        nonlocal version, done, restart, window, w_base, w_local, \
+            base, pending_windows
+        version = int(m["version"])
+        done = bool(m.get("done"))
+        restart = bool(m.get("restart"))
+        window = int(m["admit"])
+        w_base = np.asarray(arrays["w"], np.float32)
+        w_local = w_base.copy()
+        base = version
+        pending_windows = 0
+
+    def rpc(kind, meta_, arrays=None, deadline=None):
+        """One crash-tolerant round trip; folds a ``reset`` into the
+        loop state and reports it so call sites can restart their
+        iteration."""
+        nonlocal version, done, restart
+        k, m, arrs = link.request(kind, meta_, arrays,
+                                  deadline=deadline)
+        if k == "reset":
+            adopt_reset(m, arrs)
+            return k, m, arrs
+        version = int(m.get("version", version))
+        done = bool(m.get("done", done))
+        restart = bool(m.get("restart", restart))
+        return k, m, arrs
+
     try:
         if window > version:
             # pinned late admission: wait for the clock to reach the
@@ -333,20 +600,13 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                         f"admission starved: version {version} never "
                         f"reached admit window {window}")
                 time.sleep(GATE_POLL_SECONDS)
-                _, m, _ = transport.request(
-                    sock, "poll", dict(ident),
-                    deadline=rpc_deadline)
-                version = int(m.get("version", version))
-                done = bool(m.get("done"))
-                restart = bool(m.get("restart"))
+                rpc("poll", dict(ident))
             if not done and not restart:
-                _, m, arrays = transport.request(
-                    sock, "pull", dict(ident),
-                    deadline=rpc_deadline)
-                version = int(m.get("version", version))
-                w_base = np.asarray(arrays["w"], np.float32)
-                w_local = w_base.copy()
-                base = version
+                k, m, arrays = rpc("pull", dict(ident))
+                if k != "reset":
+                    w_base = np.asarray(arrays["w"], np.float32)
+                    w_local = w_base.copy()
+                    base = version
         while window < n_windows and not done and not restart:
             # the SSP gate: never more than s windows past the clock
             t_gate = time.monotonic()
@@ -356,13 +616,8 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                         f"gate starved: window {window} vs version "
                         f"{version} for {GATE_DEADLINE_SECONDS}s")
                 time.sleep(GATE_POLL_SECONDS)
-                _, m, _ = transport.request(
-                    sock, "poll", dict(ident),
-                    deadline=rpc_deadline)
-                version = int(m.get("version", version))
-                done = bool(m.get("done"))
-                restart = bool(m.get("restart"))
-                if done or restart:
+                k, _, _ = rpc("poll", dict(ident))
+                if k == "reset" or done or restart:
                     break
             if done or restart:
                 break
@@ -387,10 +642,9 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             if busy:
                 # pre-announced skip: peers' commit of THIS window
                 # must not wait out the interference
-                _, m, _ = transport.request(
-                    sock, "skip", dict(ident, window=window),
-                    deadline=rpc_deadline)
-                version = int(m.get("version", version))
+                k, _, _ = rpc("skip", dict(ident, window=window))
+                if k == "reset":
+                    continue
                 stats["skips"] += 1
                 tevents.counter("cluster.skips")
             w_local = trainer.run(w_local, window, s)
@@ -406,12 +660,14 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             # can legitimately wait out an admission hold (a respawned
             # PROCESS worker pays spawn + jax import + first compile),
             # so the recv deadline is the gate's, not the rpc's
-            k2, m, arrays = transport.request(
-                sock, "push",
+            k2, m, arrays = rpc(
+                "push",
                 dict(ident, window=window, base=base),
                 {"w": delta},
                 deadline=max(rpc_deadline, GATE_DEADLINE_SECONDS))
             rtt = (time.monotonic() - t0) * 1e3
+            if k2 == "reset":
+                continue
             if k2 == "error":
                 raise transport.TransportClosed(
                     f"push rejected: {m.get('error')}")
@@ -420,9 +676,6 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             stats["push_pull_ms_total"] += rtt
             stats["ages"].append(max(0, window - base))
             tevents.counter("cluster.pushes")
-            version = int(m.get("version", version))
-            done = bool(m.get("done"))
-            restart = bool(m.get("restart"))
             # adopt the post-commit center: fresh base, zero delta
             w_base = np.asarray(arrays["w"], np.float32)
             w_local = w_base.copy()
@@ -431,6 +684,7 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             window += 1
     finally:
         hb.stop()
+        hb_link.close()
         if not killed:
             if pending_windows:
                 # a straggle cell on the FINAL window(s) leaves
@@ -452,9 +706,8 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                 round(float(np.percentile(rtts, 50)), 3)
                 if rtts else 0.0)
             try:
-                transport.request(
-                    sock, "bye", dict(ident, stats=stats),
-                    deadline=rpc_deadline)
+                link.request("bye", dict(ident, stats=stats),
+                             retries=1)
             except transport.TransportError:
                 pass
             pssp.emit_ssp_counters(
@@ -470,10 +723,6 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                 if not isinstance(v, list)})
             log(f"[cluster] worker {slot} done: {stats['pushes']} "
                 f"push(es), {stats['skips']} skip(s)")
-            for s_ in (sock, hb_sock):
-                try:
-                    s_.close()
-                except OSError:
-                    pass
+            link.drop()
     stats["restart"] = restart
     return stats

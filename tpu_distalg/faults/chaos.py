@@ -50,6 +50,19 @@ is the fault schedule, not the FLOPs:
                      the server keeps serving, the closed-loop client
                      retries) — recovery is shed-and-retry, and the
                      final reply set must still be bitwise-identical
+  ``cluster``        the multi-process elastic runtime
+                     (``tpu_distalg/cluster/``) under a COORDINATOR
+                     kill (``cluster:coordinator`` plan rules): the
+                     launcher respawns the coordinator on the same
+                     port, it recovers from the durable WAL, the
+                     surviving workers reconnect and resume their
+                     incarnations — and because push acks are
+                     deferred until commit, the rolled-back in-flight
+                     window re-runs invisibly: the recovered run's
+                     final center is BITWISE-identical to the
+                     undisturbed run's, with an identical merge/
+                     membership event digest (standard bitwise
+                     verdict — no convergence band needed)
 
 Used three ways: the ``tda chaos`` CLI subcommand (rc 1 on any
 mismatch), ``tests/test_faults.py``'s acceptance grid, and ad-hoc
@@ -67,7 +80,7 @@ from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
 
 WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream",
-             "pagerank_stream", "serve", "ssp")
+             "pagerank_stream", "serve", "ssp", "cluster")
 
 #: the ssp workload's convergence band: |chaos final acc − undisturbed
 #: final acc| must stay inside it (a straggled + leave/rejoin run walks
@@ -96,6 +109,20 @@ class ServeChaosResult:
 
 
 @dataclasses.dataclass
+class ClusterChaosResult:
+    """The cluster workload's comparison surface: the final center
+    and the merge/membership event digest (as bytes, so it rides the
+    standard bitwise compare). Recovery evidence is carried for the
+    tests to assert the kill really fired — it never enters the
+    compare (wall clock legitimately differs)."""
+
+    center_w: np.ndarray
+    event_digest: np.ndarray
+    recoveries: int
+    recovery_ms: list
+
+
+@dataclasses.dataclass
 class ChaosResult:
     workload: str
     plan_spec: str
@@ -120,6 +147,9 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
     could consume from the result."""
     if workload in ("lr", "ssgd", "ssp"):
         return {"w": np.asarray(res.w), "accs": np.asarray(res.accs)}
+    if workload == "cluster":
+        return {"center_w": np.asarray(res.center_w),
+                "event_digest": np.asarray(res.event_digest)}
     if workload in ("kmeans", "kmeans_stream"):
         return {"centers": np.asarray(res.centers)}
     if workload == "als":
@@ -134,12 +164,49 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
 
 
 def _make_runner(workload: str, mesh, n_iterations: int | None,
-                 checkpoint_every: int | None, workdir: str):
+                 checkpoint_every: int | None, workdir: str,
+                 spawn: str = "thread"):
     """Build ``run(checkpoint_dir) -> result`` for one workload, small
     defaults. ``checkpoint_dir=None`` runs unsegmented (kmeans_stream —
     stateless, restart-from-scratch recovery). ``workdir`` hosts any
     on-disk artifact the workload needs beyond checkpoints (the
-    streamed graph cache)."""
+    streamed graph cache). ``spawn`` applies to the cluster workload
+    only (thread-mode workers for the fast smoke, real processes for
+    the genuine kill -9)."""
+    if workload == "cluster":
+        from tpu_distalg import cluster as clus
+        from tpu_distalg.cluster.local import event_digest
+
+        windows = n_iterations or 8
+        every = checkpoint_every or 3
+
+        def run(ckpt_dir):
+            # the plan drives the cluster CONFIG (schedules compile
+            # plan-pure from it): the undisturbed reference runs with
+            # the registry disabled -> no plan -> no kill
+            reg = faults.active()
+            plan_spec = reg.plan.spec() if reg is not None else None
+            cfg = clus.ClusterConfig(
+                n_slots=3, n_windows=windows, staleness=3,
+                # generous: a slow reconnect on a loaded box must not
+                # flip into a readmission and fail the bitwise
+                # verdict for the wrong reason
+                heartbeat_timeout=15.0, checkpoint_every=every,
+                checkpoint_dir=ckpt_dir, plan_spec=plan_spec,
+                train=clus.TrainTask(n_rows=1024, test_rows=512))
+            res = clus.run_local_cluster(cfg, spawn=spawn,
+                                         timeout=280.0)
+            if res["version"] != windows:
+                raise RuntimeError(
+                    f"cluster chaos run stopped at window "
+                    f"{res['version']}/{windows}")
+            return ClusterChaosResult(
+                center_w=np.asarray(res["center"]["w"]),
+                event_digest=np.frombuffer(
+                    bytes.fromhex(event_digest(res)), np.uint8),
+                recoveries=int(res.get("coordinator_recoveries", 0)),
+                recovery_ms=list(res.get("recovery_ms", [])))
+        return run
     if workload == "lr":
         from tpu_distalg.models import logistic_regression as m
         from tpu_distalg.utils import datasets
@@ -290,6 +357,7 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
               n_iterations: int | None = None,
               checkpoint_every: int | None = None,
               max_restarts: int = DEFAULT_MAX_RESTARTS,
+              spawn: str = "thread",
               logger=None) -> ChaosResult:
     """The harness core: undisturbed run, chaos run, bitwise compare.
 
@@ -311,7 +379,7 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     # shared artifact or consume its own hit counters out of schedule
     faults.configure(False)
     runner = _make_runner(workload, mesh, n_iterations, checkpoint_every,
-                          workdir)
+                          workdir, spawn=spawn)
     # kmeans_stream recovers by deterministic re-run, serve by
     # shed-and-client-retry — neither consumes a checkpoint dir
     uses_ckpt = workload not in ("kmeans_stream", "serve")

@@ -32,6 +32,18 @@ Injection points (wired at the call sites named):
                     (``cluster/transport.py``) — ``oserror`` models a
                     torn connection, ``hang`` a network partition the
                     recv deadline / heartbeat timeout must observe
+  ``cluster:coordinator``  coordinator crash schedule compilation
+                    (``cluster/coordinator.compile_coordinator_
+                    schedule``) — one probe per window; ``kill`` = the
+                    coordinator SIGKILLs itself at that window's
+                    commit point (mid-window: pushes in RAM, commit
+                    not yet WAL'd), ``hang`` = it freezes ``arg``
+                    seconds there
+  ``cluster:wal``   the coordinator's write-ahead-ledger append
+                    (``cluster/wal.py``) — ``corrupt`` REALLY flips
+                    record bytes (replay's CRC truncates the tail
+                    with a quarantine), ``oserror``/``hang`` model
+                    transient disk faults
 
   ``ckpt:write``    ``utils/checkpoint.save`` — the bytes about to land
                     on disk (``corrupt`` really flips file bytes; the
@@ -122,6 +134,8 @@ POINTS = (
     "shard:leave",
     "cluster:worker",
     "cluster:rpc",
+    "cluster:coordinator",
+    "cluster:wal",
 )
 
 KINDS = ("oserror", "hang", "corrupt", "kill", "straggle", "leave")
@@ -145,6 +159,16 @@ _POINT_KINDS = {
     "shard:leave": ("leave",),
     "cluster:worker": ("straggle", "kill"),
     "cluster:rpc": ("oserror", "hang"),
+    # the coordinator's own schedule: probed once per window by
+    # cluster/coordinator.compile_coordinator_schedule — kill = a real
+    # SIGKILL (thread mode slams every socket) at the window's commit
+    # point, hang = a frozen coordinator the workers' reconnect/
+    # deadline machinery must ride out
+    "cluster:coordinator": ("kill", "hang"),
+    # the WAL append seam (cluster/wal.py): corrupt flips record bytes
+    # (the replay CRC quarantines the tail), oserror a transient disk
+    # fault, hang a slow fsync
+    "cluster:wal": ("oserror", "hang", "corrupt"),
 }
 
 DEFAULT_HANG_SECONDS = 0.05

@@ -302,6 +302,28 @@ def render(s: dict) -> str:
             lines.append(
                 f"comm overlap: {hid} ms hidden behind compute "
                 f"({frac:.0%} of {total} ms comm time)")
+        recov = s["counters"].get("cluster.recoveries")
+        if recov:
+            # coordinator crash tolerance (cluster/wal.py +
+            # coordinator recovery): how many times the control plane
+            # died and came back, the median detect->recover->first-
+            # recommitted-window latency (launcher-measured gauge),
+            # and how many durable ledger records the recoveries
+            # replayed; reconnect/retry behavior shows per-worker in
+            # the cluster.* column table
+            g = s["gauges"]
+            c = s["counters"]
+            lines.append(
+                f"coordinator: {recov} recover(ies), median "
+                f"{g.get('cluster.recovery_ms_p50', '?')} ms, "
+                f"{c.get('cluster.wal_records_replayed', 0)} WAL "
+                f"record(s) replayed "
+                f"({c.get('cluster.wal_quarantines', 0)} torn-tail "
+                f"quarantine(s), {c.get('cluster.reconnects', 0)} "
+                f"worker reconnect(s), "
+                f"{c.get('cluster.heartbeat_retries', 0)} heartbeat "
+                f"retr(ies), {c.get('cluster.dedup_pushes', 0)} "
+                f"deduped re-push(es))")
         resh = s["counters"].get("reshard.syncs")
         if resh:
             # device-side resharding (parallel/partition.py): layout

@@ -145,14 +145,21 @@ def _strip_crc_footer(path: str, raw: bytes) -> bytes:
     return raw
 
 
-def latest_step(ckpt_dir: str) -> int | None:
+def list_steps(ckpt_dir: str) -> list[int]:
+    """Every on-disk checkpoint step, ascending (public: the cluster
+    coordinator's WAL truncation keeps segments for exactly the kept
+    checkpoints, so fallback-to-older-step can still roll forward)."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(m.group(1))
         for name in os.listdir(ckpt_dir)
         if (m := _STEP_RE.match(name))
-    ]
+    )
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    steps = list_steps(ckpt_dir)
     return max(steps) if steps else None
 
 
