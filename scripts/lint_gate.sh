@@ -1,23 +1,33 @@
 #!/usr/bin/env bash
-# The one lint gate CI (and a pre-commit human) runs: domain rules
-# (TDA0xx), style (ruff, when installed — `tda lint` chains it over
-# the same files), and the README↔artifact reconciliation. Any failure
-# fails the gate; each tool prints its own findings.
+# The one lint gate CI (and a pre-commit human) runs: domain rules —
+# per-file TDA0xx AND the project-graph TDA1xx interprocedural pass —
+# style (ruff, when installed — `tda lint` chains it over the same
+# files), and the README↔artifact reconciliation. Any failure fails
+# the gate; each tool prints its own findings.
 #
 #   scripts/lint_gate.sh            # gate the default surface
 #   scripts/lint_gate.sh --fix      # apply the mechanically-safe fixes
 #                                   # first (TDA021 daemon=, suppression
-#                                   # scaffolds), then gate
+#                                   # scaffolds/removals), then gate
 set -u
 cd "$(dirname "$0")/.."
 
 rc=0
 
-# 1. domain lint (chains ruff itself when installed)
-python -m tpu_distalg.cli lint tpu_distalg/ tests/ bench.py \
+# 1. domain lint: per-file rules + the whole-program project graph
+#    (chains ruff itself when installed)
+python -m tpu_distalg.cli lint tpu_distalg/ tests/ scripts/ bench.py \
     --baseline lint_baseline.json "$@" || rc=1
 
-# 2. README claims vs recorded bench artifacts
+# 2. the same engine through --format json: a smoke test that the
+#    project-graph pass not only finds nothing but RUNS — an engine
+#    crash (unparseable summary, resolver recursion, cache decode)
+#    must fail the gate even on a findings-clean tree
+python -m tpu_distalg.cli lint tpu_distalg/ tests/ scripts/ bench.py \
+    --baseline lint_baseline.json --format json --no-ruff \
+    > /dev/null || rc=1
+
+# 3. README claims vs recorded bench artifacts
 python scripts/check_readme_claims.py || rc=1
 
 if [ "$rc" -ne 0 ]; then

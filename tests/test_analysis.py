@@ -1087,11 +1087,12 @@ def test_tda002_bare_listdir_classified_as_filesystem():
 
 def test_committed_tree_lints_clean():
     """TIER-1 gate: the committed repo carries zero un-baselined
-    violations — the invariant every rule exists to hold."""
+    violations — per-file TDA0xx AND the project-graph TDA1xx pass —
+    the invariant every rule exists to hold."""
     from tpu_distalg import cli
 
     paths = [str(REPO / "tpu_distalg"), str(REPO / "tests"),
-             str(REPO / "bench.py")]
+             str(REPO / "scripts"), str(REPO / "bench.py")]
     rc = cli.main(["lint", *paths, "--no-ruff",
                    "--baseline", str(REPO / "lint_baseline.json")])
     assert rc == 0
@@ -1379,3 +1380,572 @@ def test_tda091_wal_append_must_fsync_before_send():
         send_frame(sock, "ack2", {})
     """
     assert "TDA091" in codes(lint(near_ack_unsafe, path=CLUS))
+
+
+# ------------------------------------------- TDA1xx: the project graph
+
+from tpu_distalg.analysis import project as projmod  # noqa: E402
+from tpu_distalg.analysis import telemetry_contract as tcmod  # noqa: E402
+
+
+def plint(tmp_path, monkeypatch, files, select=None, ignore=None,
+          changed_only=None, cache_dir=None):
+    """Write a mini-project under tmp_path (cwd-relative, so module
+    names resolve like the real tree's) and lint it whole."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    monkeypatch.chdir(tmp_path)
+    return projmod.lint_tree(
+        sorted(files), analysis.RULES, analysis.PROJECT_RULES,
+        select=select, ignore=ignore, changed_only=changed_only,
+        cache_dir=cache_dir)
+
+
+TRAINER = """
+import dataclasses
+
+
+@dataclasses.dataclass
+class TrainCarry:
+    w: list
+    acc: float
+    res: list     # the EF residual of the topk schedule
+
+
+def step(carry):
+    carry.w = [x - 1 for x in carry.w]
+    carry.acc = 0.5
+    carry.res = [x * 2 for x in carry.res]
+    return carry
+"""
+
+#: the PR 5 pre-fix spelling, reconstructed: carry grew `res`, the
+#: payload builder (another module) kept serializing the old shape
+CKPT_DROPS_RES = """
+from miniproj.trainer import TrainCarry
+
+
+def payload(c: TrainCarry) -> dict:
+    return {"w": c.w, "acc": c.acc}
+"""
+
+CKPT_CARRIES_RES = """
+from miniproj.trainer import TrainCarry
+
+
+def payload(c: TrainCarry) -> dict:
+    return {"w": c.w, "acc": c.acc, "res": c.res}
+"""
+
+
+def test_tda100_dropped_carry_field_flagged(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/trainer.py": TRAINER,
+                 "miniproj/ckpt.py": CKPT_DROPS_RES},
+                select=("TDA100",))
+    assert [v.code for v in res.violations] == ["TDA100"]
+    v = res.violations[0]
+    assert v.path == "miniproj/ckpt.py"
+    assert "'res'" in v.message and "TrainCarry" in v.message
+
+
+def test_tda100_complete_payload_clean(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/trainer.py": TRAINER,
+                 "miniproj/ckpt.py": CKPT_CARRIES_RES},
+                select=("TDA100",))
+    assert res.violations == []
+
+
+def test_tda100_resolves_reexport_alias(tmp_path, monkeypatch):
+    """The dataclass reaches the payload builder through a re-export
+    chain with a rename — the graph still resolves it."""
+    res = plint(tmp_path, monkeypatch, {
+        "miniproj/__init__.py": "",
+        "miniproj/trainer.py": TRAINER,
+        "miniproj/api.py":
+            "from miniproj.trainer import TrainCarry as TC\n",
+        "miniproj/ckpt.py": """
+            from miniproj.api import TC
+
+
+            def payload(c: TC) -> dict:
+                return {"w": c.w, "acc": c.acc}
+            """,
+    }, select=("TDA100",))
+    assert [v.code for v in res.violations] == ["TDA100"]
+
+
+CONFIG = """
+import dataclasses
+
+
+@dataclasses.dataclass
+class JobConfig:
+    beat_interval: float = 0.5
+    n_windows: int = 8
+    staleness: int = 4
+"""
+
+MINICLI = """
+import argparse
+
+from miniproj.config import JobConfig
+from miniproj.sync import SyncSpec
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--beat-interval", type=float, default=0.5)
+    p.add_argument("--n-windows", type=int, default=8)
+    p.add_argument("--sync", default="ssp:4")
+    return p
+
+
+def main(args):
+    spec = SyncSpec.parse(args.sync)
+    return JobConfig(beat_interval=args.beat_interval,
+                     n_windows=args.n_windows,
+                     staleness=spec.staleness)
+"""
+
+SYNCMOD = """
+class SyncSpec:
+    @staticmethod
+    def parse(text):
+        return None
+"""
+
+#: the PR 13 pre-fix spelling, reconstructed: the launcher re-spawns
+#: the role but forwards only --n-windows — the child runs default
+#: heartbeat timing and sync mode
+LAUNCHER_LOSSY = """
+import sys
+
+from miniproj.config import JobConfig
+
+
+def spawn(config: JobConfig):
+    return [sys.executable, "-m", "miniproj.cli",
+            "--n-windows", str(config.n_windows)]
+"""
+
+LAUNCHER_COMPLETE = """
+import sys
+
+from miniproj.config import JobConfig
+
+
+def spawn(config: JobConfig):
+    return [sys.executable, "-m", "miniproj.cli",
+            "--n-windows", str(config.n_windows),
+            "--beat-interval", str(config.beat_interval),
+            "--sync", f"ssp:{config.staleness}"]
+"""
+
+
+def test_tda101_lossy_argv_handoff_flagged(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/config.py": CONFIG,
+                 "miniproj/sync.py": SYNCMOD,
+                 "miniproj/cli.py": MINICLI,
+                 "miniproj/launcher.py": LAUNCHER_LOSSY},
+                select=("TDA101",))
+    msgs = [v.message for v in res.violations]
+    assert [v.code for v in res.violations] == ["TDA101", "TDA101"]
+    assert any("beat_interval" in m and "--beat-interval" in m
+               for m in msgs)
+    # one level of local dataflow: staleness came from
+    # SyncSpec.parse(args.sync), so --sync is the owed flag
+    assert any("staleness" in m and "--sync" in m for m in msgs)
+
+
+def test_tda101_complete_argv_clean(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/config.py": CONFIG,
+                 "miniproj/sync.py": SYNCMOD,
+                 "miniproj/cli.py": MINICLI,
+                 "miniproj/launcher.py": LAUNCHER_COMPLETE},
+                select=("TDA101",))
+    assert res.violations == []
+
+
+BENCH_DRIFTED = """
+ALL_METRIC_NAMES = ("good_metric", "ghost_metric")
+
+
+def emit(out):
+    out({"metric": "good_metric", "value": 1.0})
+    out({"metric": "rogue_metric", "value": 2.0})
+"""
+
+
+def test_tda102_bench_metric_drift_both_directions(tmp_path,
+                                                   monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/bench_emit.py": BENCH_DRIFTED},
+                select=("TDA102",))
+    msgs = sorted(v.message for v in res.violations)
+    assert [v.code for v in res.violations] == ["TDA102", "TDA102"]
+    assert any("ghost_metric" in m and "no emission site" in m
+               for m in msgs)
+    assert any("rogue_metric" in m and "missing from" in m
+               for m in msgs)
+
+
+TELMOD = """
+def counter(name, n=1):
+    pass
+
+
+def gauge(name, value):
+    pass
+"""
+
+EMITTER = """
+from miniproj import tel
+
+
+def work(code):
+    tel.counter("seen.requests")
+    tel.counter("unseen.leak")
+    tel.counter(f"percode.{code}")
+"""
+
+
+def _report_mod(waivers):
+    return f"""
+SUMMARY_ONLY_COUNTERS = {waivers!r}
+PER_WORKER_PREFIXES = ("col.",)
+
+
+def render(s):
+    return "requests: " + str(s.get("seen.requests"))
+"""
+
+
+def test_tda102_unrendered_counter_flagged(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/tel.py": TELMOD,
+                 "miniproj/emitter.py": EMITTER,
+                 "miniproj/report_mod.py": _report_mod(("x.y",))},
+                select=("TDA102",))
+    msgs = [v.message for v in res.violations]
+    assert len(res.violations) == 2
+    assert any("'unseen.leak'" in m for m in msgs)
+    assert any("percode." in m and "f-string family" in m
+               for m in msgs)
+
+
+def test_tda102_waiver_and_render_cover_counters(tmp_path,
+                                                 monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/tel.py": TELMOD,
+                 "miniproj/emitter.py": EMITTER,
+                 "miniproj/report_mod.py": _report_mod(
+                     ("unseen.leak", "percode.*"))},
+                select=("TDA102",))
+    assert res.violations == []
+
+
+def _writer(name, lock, other=None):
+    imp = f"from miniproj import {other}\n" if other else ""
+    return f"""
+import threading
+
+from miniproj import shared
+{imp}
+
+{lock} = threading.Lock()
+
+
+def {name}_loop():
+    with {lock}:
+        shared.BOX.buf = 1
+
+
+def start():
+    t = threading.Thread(target={name}_loop, daemon=True)
+    t.start()
+    return t
+"""
+
+
+def test_tda103_split_locks_across_modules_flagged(tmp_path,
+                                                   monkeypatch):
+    res = plint(tmp_path, monkeypatch, {
+        "miniproj/__init__.py": "",
+        "miniproj/shared.py": "class Box:\n    pass\n\n\n"
+                              "BOX = Box()\n",
+        "miniproj/writer_a.py": _writer("a", "A_LOCK"),
+        "miniproj/writer_b.py": _writer("b", "B_LOCK",
+                                        other="writer_a"),
+    }, select=("TDA103",))
+    assert [v.code for v in res.violations] == ["TDA103", "TDA103"]
+    assert {v.path for v in res.violations} == {
+        "miniproj/writer_a.py", "miniproj/writer_b.py"}
+    assert all("no common lock" in v.message.lower()
+               or "different lock" in v.message.lower()
+               for v in res.violations)
+
+
+def test_tda103_shared_lock_clean(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch, {
+        "miniproj/__init__.py": "",
+        "miniproj/shared.py": "import threading\n\n\n"
+                              "class Box:\n    pass\n\n\n"
+                              "BOX = Box()\n"
+                              "BOX_LOCK = threading.Lock()\n",
+        "miniproj/writer_a.py": _writer("a", "shared.BOX_LOCK"),
+        "miniproj/writer_b.py": _writer("b", "shared.BOX_LOCK",
+                                        other="writer_a"),
+    }, select=("TDA103",))
+    assert res.violations == []
+
+
+def test_project_graph_cache_hits_and_invalidation(tmp_path,
+                                                   monkeypatch):
+    files = {"miniproj/__init__.py": "",
+             "miniproj/trainer.py": TRAINER,
+             "miniproj/ckpt.py": CKPT_DROPS_RES}
+    res1 = plint(tmp_path, monkeypatch, files, select=("TDA100",),
+                 cache_dir=".lintcache")
+    assert res1.n_cached == 0
+    assert len(res1.violations) == 1
+    res2 = plint(tmp_path, monkeypatch, files, select=("TDA100",),
+                 cache_dir=".lintcache")
+    assert res2.n_cached == len(files)
+    assert len(res2.violations) == 1   # cached summaries, same verdict
+    # edit ONE file: only it re-extracts, and the verdict follows the
+    # new content
+    files2 = dict(files, **{"miniproj/ckpt.py": CKPT_CARRIES_RES})
+    res3 = plint(tmp_path, monkeypatch, files2, select=("TDA100",),
+                 cache_dir=".lintcache")
+    assert res3.n_cached == len(files) - 1
+    assert res3.violations == []
+
+
+def test_changed_only_lints_subset_but_graph_sees_all(tmp_path,
+                                                      monkeypatch):
+    """--changed semantics: a per-file violation in an UNCHANGED file
+    is not reported, but a project-graph violation anchored there
+    still is — the graph always covers the whole surface."""
+    files = {
+        "miniproj/__init__.py": "",
+        "miniproj/trainer.py": TRAINER,
+        "miniproj/ckpt.py": CKPT_DROPS_RES,
+        # a per-file finding (TDA021: bare Thread) in a file we will
+        # NOT mark changed
+        "miniproj/threads.py": "import threading\n\n\n"
+                               "def go():\n"
+                               "    threading.Thread(target=go)"
+                               ".start()\n",
+    }
+    res = plint(tmp_path, monkeypatch, files,
+                changed_only={"miniproj/trainer.py"})
+    assert res.n_linted == 1
+    codes_found = [v.code for v in res.violations]
+    assert "TDA100" in codes_found          # graph: unchanged ckpt.py
+    assert "TDA021" not in codes_found      # per-file: not re-linted
+    # full run still sees both
+    res_full = plint(tmp_path, monkeypatch, files)
+    codes_full = [v.code for v in res_full.violations]
+    assert "TDA100" in codes_full and "TDA021" in codes_full
+
+
+def test_suppression_in_unchanged_file_still_covers_graph_finding(
+        tmp_path, monkeypatch):
+    pinned = CKPT_DROPS_RES.replace(
+        'return {"w": c.w, "acc": c.acc}',
+        '# tda: ignore[TDA100] -- fixture: res is rebuilt at load\n'
+        '    return {"w": c.w, "acc": c.acc}')
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/trainer.py": TRAINER,
+                 "miniproj/ckpt.py": pinned},
+                changed_only={"miniproj/trainer.py"})
+    assert [v for v in res.violations if v.code == "TDA100"] == []
+
+
+def test_unused_suppression_reported_and_fix_removes(tmp_path,
+                                                     monkeypatch):
+    src = ("def f():\n"
+           "    return 1  # tda: ignore[TDA001] -- stale: the clock "
+           "call is long gone\n")
+    res = plint(tmp_path, monkeypatch, {"miniproj/mod.py": src})
+    assert len(res.violations) == 1
+    v = res.violations[0]
+    assert v.code == "TDA000" and "suppresses no findings" in v.message
+    fixed, n = fixes.fix_source(src, [v])
+    assert n == 1
+    assert "tda: ignore" not in fixed
+    assert "return 1" in fixed
+
+
+def test_unused_own_line_suppression_fix_deletes_line(tmp_path,
+                                                      monkeypatch):
+    src = ("# tda: ignore[TDA002] -- stale pin on its own line\n"
+           "def f():\n"
+           "    return 1\n")
+    res = plint(tmp_path, monkeypatch, {"miniproj/mod.py": src})
+    assert [v.code for v in res.violations] == ["TDA000"]
+    fixed, n = fixes.fix_source(src, res.violations)
+    assert n == 1 and "tda: ignore" not in fixed
+    assert fixed.startswith("def f():")
+
+
+def test_unused_suppression_not_reported_under_select(tmp_path,
+                                                      monkeypatch):
+    """A --select run sees a FILTERED finding set; silence there must
+    not read as rot."""
+    src = ("def f():\n"
+           "    return 1  # tda: ignore[TDA001] -- maybe used by a "
+           "rule this run skipped\n")
+    res = plint(tmp_path, monkeypatch, {"miniproj/mod.py": src},
+                select=("TDA002",))
+    assert res.violations == []
+
+
+def test_used_suppression_not_reported_as_unused(tmp_path,
+                                                 monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/trainer.py": TRAINER,
+                 "miniproj/ckpt.py": CKPT_DROPS_RES.replace(
+                     'return {"w": c.w, "acc": c.acc}',
+                     '# tda: ignore[TDA100] -- fixture: rebuilt at '
+                     'load\n    return {"w": c.w, "acc": c.acc}')})
+    assert [v for v in res.violations
+            if "suppresses no findings" in v.message] == []
+
+
+def test_cli_changed_flag_uses_git_view(tmp_path, monkeypatch,
+                                        capsys):
+    from tpu_distalg import cli
+
+    for rel, src in {
+            "miniproj/__init__.py": "",
+            "miniproj/trainer.py": TRAINER,
+            "miniproj/ckpt.py": CKPT_DROPS_RES}.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(lint_cli, "_git_changed",
+                        lambda: {"miniproj/trainer.py"})
+    rc = cli.main(["lint", "miniproj", "--no-ruff", "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1                      # the graph finding still gates
+    assert "TDA100" in out
+    assert "1 linted, graph over all" in out
+
+
+def test_metric_contract_collector_matches_bench():
+    """Satellite: the three per-test AST tripwires now route through
+    THIS collector — pin its verdict on the real bench.py here."""
+    contract = tcmod.bench_contract(str(REPO))
+    assert "ssgd_lr_steps_per_sec_per_chip" in contract.canonical
+    unemitted, rogue = tcmod.contract_problems(contract)
+    assert unemitted == [] and rogue == {}
+    tcmod.assert_registered(["ssgd_lr_steps_per_sec_per_chip"],
+                            str(REPO))
+    with pytest.raises(AssertionError):
+        tcmod.assert_registered(["no_such_metric_anywhere"],
+                                str(REPO))
+
+
+def test_project_rules_have_codes_and_invariants():
+    assert [r.code for r in analysis.PROJECT_RULES] == [
+        "TDA100", "TDA101", "TDA102", "TDA103"]
+    for rule in analysis.PROJECT_RULES:
+        assert engine.CODE_RE.match(rule.code)
+        assert rule.invariant and rule.name
+        assert rule.check(None) == ()   # per-file hook is inert
+
+
+def test_graph_tolerates_syntax_error_file(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/trainer.py": TRAINER,
+                 "miniproj/ckpt.py": CKPT_DROPS_RES,
+                 "miniproj/broken.py": "def broken(:\n"})
+    by_code = {v.code for v in res.violations}
+    assert "TDA000" in by_code          # the parse failure
+    assert "TDA100" in by_code          # the graph still ran
+
+
+def test_tda100_resolves_relative_reexport_in_package_init(
+        tmp_path, monkeypatch):
+    """`from .trainer import TrainCarry` inside the package __init__
+    (a RELATIVE import in a package module — one level means the
+    package itself, not its parent) still resolves."""
+    res = plint(tmp_path, monkeypatch, {
+        "miniproj/__init__.py":
+            "from .trainer import TrainCarry\n",
+        "miniproj/trainer.py": TRAINER,
+        "miniproj/ckpt.py": """
+            from miniproj import TrainCarry
+
+
+            def payload(c: TrainCarry) -> dict:
+                return {"w": c.w, "acc": c.acc}
+            """,
+    }, select=("TDA100",))
+    assert [v.code for v in res.violations] == ["TDA100"]
+
+
+def test_unused_multiline_pin_fix_removes_whole_block(tmp_path,
+                                                      monkeypatch):
+    src = ("def f():\n"
+           "    # tda: ignore[TDA002] -- stale pin whose reason\n"
+           "    # wraps onto a second and a third comment line\n"
+           "    # before the code it once covered\n"
+           "    return 1\n"
+           "    # an unrelated comment at ANOTHER indent survives\n")
+    res = plint(tmp_path, monkeypatch, {"miniproj/mod.py": src})
+    assert [v.code for v in res.violations] == ["TDA000"]
+    fixed, n = fixes.fix_source(src, res.violations)
+    assert n == 3              # the pin line + its two continuations
+    assert "tda: ignore" not in fixed
+    assert "wraps onto" not in fixed and "once covered" not in fixed
+    assert "unrelated comment" in fixed
+    assert "return 1" in fixed
+
+
+def test_cache_subset_run_does_not_evict_other_entries(tmp_path,
+                                                       monkeypatch):
+    files = {"miniproj/__init__.py": "",
+             "miniproj/trainer.py": TRAINER,
+             "miniproj/ckpt.py": CKPT_CARRIES_RES}
+    plint(tmp_path, monkeypatch, files, select=("TDA100",),
+          cache_dir=".lintcache")
+    # a subset invocation must leave the other summaries cached
+    projmod.lint_tree(["miniproj/trainer.py"], analysis.RULES,
+                      analysis.PROJECT_RULES, select=("TDA100",),
+                      cache_dir=".lintcache")
+    res = plint(tmp_path, monkeypatch, files, select=("TDA100",),
+                cache_dir=".lintcache")
+    assert res.n_cached == len(files)
+
+
+def test_git_changed_is_cwd_relative_from_subdir(tmp_path,
+                                                 monkeypatch):
+    import subprocess
+
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    monkeypatch.chdir(tmp_path / "pkg")
+    changed = lint_cli._git_changed()
+    # git reports 'pkg/mod.py' (repo-root-relative); the lint file
+    # list is cwd-relative, so the set must say 'mod.py'
+    assert changed == {"mod.py"}

@@ -83,46 +83,29 @@ def test_all_metric_names_match_emission_sites():
     real emissions live in the phase functions — tie the two together
     statically so a rename/addition in either place fails loudly
     instead of rotting into stale skipped-with-zero lines (the exact
-    drift the hygiene rider exists to prevent). Every canonical name
-    must match a ``"metric": ...`` emission site in bench.py (literal
-    or f-string family), and every literal emission must be canonical."""
-    import ast
-    import re
-    from pathlib import Path
+    drift the hygiene rider exists to prevent).
+
+    The AST walk that used to live here (and, re-implemented, in
+    test_cluster/test_partition) is now the TDA102 collector — ONE
+    implementation, run by `tda lint` on every gate and called here so
+    both drift directions keep a direct unit-test spelling too."""
+    import os
 
     import bench
+    from tpu_distalg.analysis import telemetry_contract as tc
 
-    tree = ast.parse(Path(bench.__file__).read_text())
-    literals: set = set()
-    templates = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Dict):
-            continue
-        for k, v in zip(node.keys, node.values):
-            if not (isinstance(k, ast.Constant) and k.value == "metric"):
-                continue
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                literals.add(v.value)
-            elif isinstance(v, ast.JoinedStr):
-                pat = "".join(
-                    re.escape(p.value)
-                    if isinstance(p, ast.Constant) else ".+"
-                    for p in v.values)
-                templates.append(re.compile(f"^{pat}$"))
-    # ALL_METRIC_NAMES itself is a tuple of constants, not emission
-    # dicts, so it never self-satisfies this check
-    unemitted = [
-        n for n in bench.ALL_METRIC_NAMES
-        if n not in literals and not any(t.match(n) for t in templates)]
+    root = os.path.dirname(os.path.abspath(bench.__file__))
+    contract = tc.bench_contract(root)
+    assert set(contract.canonical) == set(bench.ALL_METRIC_NAMES)
+    unemitted, rogue = tc.contract_problems(contract)
     assert not unemitted, (
         f"canonical metrics with no emission site in bench.py "
         f"(renamed phase metric without updating ALL_METRIC_NAMES?): "
         f"{unemitted}")
-    rogue = sorted(literals - set(bench.ALL_METRIC_NAMES))
     assert not rogue, (
         f"metric emissions missing from ALL_METRIC_NAMES (the CPU "
         f"fallback would leave these blank on a dead-backend round): "
-        f"{rogue}")
+        f"{sorted(rogue)}")
 
 
 def test_artifact_loader_skips_cpu_fallback_rounds(tmp_path):

@@ -981,11 +981,16 @@ def test_cluster_bench_fast_mode_emits_all_three_metrics():
 
 def test_cluster_metrics_registered_for_claims_and_fallback():
     import bench
+    from tpu_distalg.analysis import telemetry_contract as tc
 
-    for name in ("ssgd_cluster_elastic_speedup",
-                 "cluster_push_pull_ms",
-                 "cluster_coordinator_recovery_ms"):
-        assert name in bench.ALL_METRIC_NAMES
+    # membership AND a live emission site, via the one TDA102
+    # collector (the per-file AST re-implementation this test carried
+    # is gone)
+    tc.assert_registered(
+        ("ssgd_cluster_elastic_speedup",
+         "cluster_push_pull_ms",
+         "cluster_coordinator_recovery_ms"),
+        os.path.dirname(os.path.abspath(bench.__file__)))
     assert "cluster_push_pull_ms" in bench.LOWER_IS_BETTER_METRICS
     assert "cluster_coordinator_recovery_ms" in \
         bench.LOWER_IS_BETTER_METRICS

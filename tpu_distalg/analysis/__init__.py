@@ -50,16 +50,43 @@ TDA090      cluster transport discipline in ``tpu_distalg/cluster/``:
             stream)
 ==========  =========================================================
 
+The ``TDA1xx`` family runs over the PROJECT GRAPH
+(:mod:`tpu_distalg.analysis.project` — one parse of the whole lint
+surface into cross-module symbol/flow summaries) instead of one file
+at a time; each rule pins a bug class review caught across PR 9–13:
+
+==========  =========================================================
+TDA100      checkpoint-carry completeness: a state-container field
+            mutated across steps must reach its checkpoint/snapshot
+            payload builder (the topk EF-residual class)
+TDA101      subprocess config handoff: every config field the CLI
+            feeds from a flag is forwarded by the argv builder that
+            re-spawns the role (the ``--train-json`` class)
+TDA102      telemetry contract: every emitted counter/gauge is
+            rendered or waived in ``telemetry/report.py``, and bench
+            metric lines stay bijective with ``ALL_METRIC_NAMES``
+            (the test-only AST tripwire, promoted into the engine)
+TDA103      cross-module lock discipline: an attribute written from
+            thread entries in different modules needs ONE common
+            lock, not one lock per module (the gap TDA020's
+            single-file view cannot see)
+==========  =========================================================
+
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
 is mandatory); grandfather existing debt with ``lint_baseline.json``.
-Run via ``tda lint [paths] [--format json] [--baseline FILE]
-[--select/--ignore CODES] [--fix]``. Stdlib + telemetry only — no jax.
+A reasoned suppression that suppresses NOTHING is itself reported
+(like a stale baseline entry) and ``--fix`` removes it. Run via
+``tda lint [paths] [--format json] [--baseline FILE] [--select/
+--ignore CODES] [--changed] [--fix]``. Stdlib + telemetry only — no
+jax.
 """
 
 from tpu_distalg.analysis import baseline
+from tpu_distalg.analysis.carry import RULES as _CARRY
 from tpu_distalg.analysis.cluster import RULES as _CLUSTER
 from tpu_distalg.analysis.comms import RULES as _COMMS
 from tpu_distalg.analysis.concurrency import RULES as _CONCURRENCY
+from tpu_distalg.analysis.crosslock import RULES as _CROSSLOCK
 from tpu_distalg.analysis.determinism import RULES as _DETERMINISM
 from tpu_distalg.analysis.engine import (
     Rule,
@@ -68,25 +95,45 @@ from tpu_distalg.analysis.engine import (
     lint_file,
     lint_source,
 )
+from tpu_distalg.analysis.handoff import RULES as _HANDOFF
 from tpu_distalg.analysis.pallas import RULES as _PALLAS
 from tpu_distalg.analysis.partition import RULES as _PARTITION
+from tpu_distalg.analysis.project import (
+    ProjectContext,
+    ProjectRule,
+    build_project,
+    lint_tree,
+)
 from tpu_distalg.analysis.seams import RULES as _SEAMS
 from tpu_distalg.analysis.serve import RULES as _SERVE
 from tpu_distalg.analysis.ssp import RULES as _SSP
+from tpu_distalg.analysis.telemetry_contract import (
+    RULES as _TELEMETRY_CONTRACT,
+)
 from tpu_distalg.analysis.tracing import RULES as _TRACING
 
-#: every shipped rule, in code order
+#: every shipped per-file rule, in code order
 RULES = tuple(sorted(
     _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
     + _SERVE + _SSP + _PARTITION + _CLUSTER,
     key=lambda r: r.code))
 
+#: the interprocedural family — runs once over the project graph
+PROJECT_RULES = tuple(sorted(
+    _CARRY + _HANDOFF + _TELEMETRY_CONTRACT + _CROSSLOCK,
+    key=lambda r: r.code))
+
 __all__ = [
+    "PROJECT_RULES",
+    "ProjectContext",
+    "ProjectRule",
     "RULES",
     "Rule",
     "Violation",
     "baseline",
+    "build_project",
     "iter_python_files",
     "lint_file",
     "lint_source",
+    "lint_tree",
 ]

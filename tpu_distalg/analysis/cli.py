@@ -18,11 +18,16 @@ import sys
 
 from tpu_distalg.analysis import baseline as blmod
 from tpu_distalg.analysis import engine, fixes
+from tpu_distalg.analysis import project as projmod
 from tpu_distalg.telemetry import events as tevents
 
 #: the repo's default lint surface (existing entries only, so the
 #: command works from any subdirectory too)
-DEFAULT_PATHS = ("tpu_distalg", "tests", "bench.py")
+DEFAULT_PATHS = ("tpu_distalg", "tests", "scripts", "bench.py")
+
+#: the project-graph summary cache home (shared with bench's caches);
+#: silently skipped when unwritable
+CACHE_DIR = ".bench_cache"
 
 
 def add_parser_args(p):
@@ -49,7 +54,15 @@ def add_parser_args(p):
     p.add_argument("--fix", action="store_true",
                    help="apply the mechanically-safe fixes (TDA021 "
                         "daemon=False; scaffold reasonless "
-                        "suppressions) and re-lint")
+                        "suppressions; remove unused ones) and "
+                        "re-lint")
+    p.add_argument("--changed", action="store_true",
+                   help="incremental mode: run the per-file TDA0xx "
+                        "rules only over git-modified files, while "
+                        "the TDA1xx project graph still covers the "
+                        "whole surface (summaries content-hash-"
+                        "cached under .bench_cache/); stale-baseline "
+                        "errors are skipped (partial view)")
     p.add_argument("--no-ruff", action="store_true",
                    help="skip the chained ruff run even when ruff is "
                         "installed")
@@ -63,7 +76,7 @@ def _codes(arg: str | None):
 
 
 def run_lint(args) -> int:
-    from tpu_distalg.analysis import RULES
+    from tpu_distalg.analysis import PROJECT_RULES, RULES
 
     paths = list(args.paths) or [p for p in DEFAULT_PATHS
                                  if os.path.exists(p)]
@@ -75,18 +88,60 @@ def run_lint(args) -> int:
         files = engine.iter_python_files(paths)
         select, ignore = _codes(args.select), _codes(args.ignore)
         with tevents.span("lint", files=len(files)):
-            rc = _run(args, files, RULES, select, ignore)
+            rc = _run(args, files, RULES, PROJECT_RULES, select,
+                      ignore)
         return rc
     except (FileNotFoundError, ValueError) as e:
         print(f"tda lint: {e}", file=sys.stderr)
         return 2
 
 
-def _run(args, files, rules, select, ignore) -> int:
-    violations = []
-    for path in files:
-        violations.extend(engine.lint_file(
-            path, rules, select=select, ignore=ignore))
+def _git_changed() -> set | None:
+    """Worktree-modified .py paths (staged + unstaged + untracked),
+    norm_path-spelled RELATIVE TO THE CWD (git reports repo-root-
+    relative paths; a subdirectory run must still intersect with the
+    cwd-relative lint file list); None (= lint everything) when git is
+    absent or this is not a work tree."""
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"],
+            capture_output=True, text=True, timeout=30)
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode or top.returncode:
+        return None
+    root = top.stdout.strip()
+    out: set = set()
+    for line in proc.stdout.splitlines():
+        rest = line[3:]
+        if " -> " in rest:                    # rename: new side counts
+            rest = rest.split(" -> ", 1)[1]
+        rest = rest.strip().strip('"')
+        if rest.endswith(".py"):
+            # absolute, then norm_path re-relativizes against the cwd
+            out.add(engine.norm_path(os.path.join(root, rest)))
+    return out
+
+
+def _run(args, files, rules, project_rules, select, ignore) -> int:
+    changed = None
+    if args.changed:
+        changed = _git_changed()
+        if changed is None:
+            print("tda lint: --changed needs a git work tree; "
+                  "linting everything", file=sys.stderr)
+
+    def lint_once():
+        return projmod.lint_tree(
+            files, rules, project_rules, select=select,
+            ignore=ignore, changed_only=changed,
+            cache_dir=CACHE_DIR)
+
+    result = lint_once()
+    violations = result.violations
 
     if args.fix and violations:
         by_file = collections.defaultdict(list)
@@ -96,12 +151,12 @@ def _run(args, files, rules, select, ignore) -> int:
                       for p, vs in by_file.items())
         if n_fixed:
             print(f"tda lint: applied {n_fixed} fix(es); re-linting")
-            violations = []
-            for path in files:
-                violations.extend(engine.lint_file(
-                    path, rules, select=select, ignore=ignore))
+            result = lint_once()
+            violations = result.violations
 
-    tevents.counter("lint.files", len(files))
+    tevents.counter("lint.files", result.n_linted)
+    tevents.counter("lint.cached", result.n_cached)
+    tevents.gauge("lint.graph_seconds", result.graph_seconds)
     tevents.counter("lint.violations", len(violations))
     for code, n in collections.Counter(
             v.code for v in violations).items():
@@ -119,12 +174,22 @@ def _run(args, files, rules, select, ignore) -> int:
     if bl_path is not None:
         doc = blmod.load(bl_path)
         violations, baselined, stale = blmod.apply(doc, violations)
+        if changed is not None:
+            # a --changed run sees a PARTIAL violation set: entries
+            # for un-linted files would all read as stale
+            stale = []
 
-    ruff_rc, ruff_out = (0, "") if args.no_ruff else _chain_ruff(files)
+    ruff_files = files if changed is None else \
+        [f for f in files if engine.norm_path(f) in changed]
+    ruff_rc, ruff_out = (0, "") if args.no_ruff or not ruff_files \
+        else _chain_ruff(ruff_files)
 
     if args.format == "json":
         print(json.dumps({
             "files": len(files),
+            "linted": result.n_linted,
+            "cached": result.n_cached,
+            "graph_seconds": result.graph_seconds,
             "violations": [v.as_dict() for v in violations],
             "baselined": len(baselined),
             "stale_baseline": stale,
@@ -140,8 +205,12 @@ def _run(args, files, rules, select, ignore) -> int:
             print(f"{e['path']}: stale baseline entry {e['code']} "
                   f"({e['snippet']!r}) — the violation is gone; "
                   f"regenerate with --update-baseline")
-        summary = (f"tda lint: {len(files)} file(s), "
-                   f"{len(violations)} violation(s)")
+        summary = (f"tda lint: {len(files)} file(s)"
+                   + (f" ({result.n_linted} linted, graph over all)"
+                      if changed is not None else "")
+                   + f", {len(violations)} violation(s)")
+        if result.n_cached:
+            summary += f", {result.n_cached} graph summar(ies) cached"
         if baselined:
             summary += f", {len(baselined)} baselined"
         if stale:
@@ -149,6 +218,7 @@ def _run(args, files, rules, select, ignore) -> int:
         print(summary)
 
     tevents.emit("lint_summary", files=len(files),
+                 linted=result.n_linted, cached=result.n_cached,
                  violations=len(violations), baselined=len(baselined),
                  stale=len(stale), ruff_rc=ruff_rc)
     return 1 if (violations or stale or ruff_rc) else 0

@@ -302,8 +302,11 @@ def make_context(source: str, path: str) -> LintContext:
         is_telemetry=tel, is_test=test)
 
 
-def _select(rules, select=None, ignore=None):
-    known = {r.code for r in rules} | {"TDA000"}
+def _select(rules, select=None, ignore=None, known=None):
+    """Filter ``rules`` by --select/--ignore codes. ``known`` widens
+    the validation set (the CLI validates against per-file AND project
+    rules together, then filters each family separately)."""
+    known = set(known or ()) | {r.code for r in rules} | {"TDA000"}
     for group in (select or ()), (ignore or ()):
         for c in group:
             if c not in known:
@@ -316,6 +319,59 @@ def _select(rules, select=None, ignore=None):
     return out
 
 
+def apply_suppressions(violations, suppressions) -> list:
+    """Drop findings covered by a REASONED suppression whose line sits
+    in the finding's statement span; mark those suppressions used (the
+    unused-pin report reads the flag). Shared by the per-file pass and
+    the project pass so one pin serves both."""
+    kept = []
+    for v in sorted(violations, key=lambda v: (v.line, v.col,
+                                               v.code)):
+        span_end = max(v.line, v.end_line)
+        supp = next(
+            (s for s in suppressions
+             if v.line <= s.line <= span_end
+             and v.code in s.codes and s.reason),
+            None)
+        if supp is not None:
+            supp.used = True
+            continue
+        kept.append(v)
+    return kept
+
+
+def marker_violations(ctx: "LintContext") -> list:
+    """The engine's own TDA000 findings for one parsed file: bare
+    (reasonless) suppressions and malformed markers."""
+    out = []
+    for s in ctx.markers.suppressions:
+        if not s.reason:
+            out.append(Violation(
+                code="TDA000", path=ctx.path, line=s.comment_line,
+                col=0,
+                message=(
+                    "suppression without a reason — write "
+                    "'# tda: ignore[CODE] -- why it is safe' "
+                    "(an unexplained ignore is unreviewable)"),
+                snippet=ctx.lines[s.comment_line - 1].strip()
+                if s.comment_line <= len(ctx.lines) else ""))
+    for line, msg in ctx.markers.malformed:
+        out.append(Violation(
+            code="TDA000", path=ctx.path, line=line, col=0,
+            message=msg,
+            snippet=ctx.lines[line - 1].strip()
+            if line <= len(ctx.lines) else ""))
+    return out
+
+
+def syntax_violation(path: str, e: SyntaxError) -> Violation:
+    return Violation(
+        code="TDA000", path=norm_path(path),
+        line=e.lineno or 1, col=(e.offset or 1) - 1,
+        message=f"file does not parse: {e.msg}",
+        snippet=(e.text or "").strip())
+
+
 def lint_source(source: str, path: str, rules, *,
                 select=None, ignore=None) -> list:
     """Lint one source string. Returns surviving violations (TDA000
@@ -326,13 +382,7 @@ def lint_source(source: str, path: str, rules, *,
     try:
         ctx = make_context(source, path)
     except SyntaxError as e:
-        if not tda000:
-            return []
-        return [Violation(
-            code="TDA000", path=norm_path(path),
-            line=e.lineno or 1, col=(e.offset or 1) - 1,
-            message=f"file does not parse: {e.msg}",
-            snippet=(e.text or "").strip())]
+        return [syntax_violation(path, e)] if tda000 else []
 
     found: list[Violation] = []
     for rule in active:
@@ -341,36 +391,9 @@ def lint_source(source: str, path: str, rules, *,
 
     # suppressions: reasoned ones drop matching findings; bare ones
     # suppress NOTHING and are reported themselves
-    kept = []
-    for v in sorted(found, key=lambda v: (v.line, v.col, v.code)):
-        span_end = max(v.line, v.end_line)
-        supp = next(
-            (s for s in ctx.markers.suppressions
-             if v.line <= s.line <= span_end
-             and v.code in s.codes and s.reason),
-            None)
-        if supp is not None:
-            supp.used = True
-            continue
-        kept.append(v)
+    kept = apply_suppressions(found, ctx.markers.suppressions)
     if tda000:
-        for s in ctx.markers.suppressions:
-            if not s.reason:
-                kept.append(Violation(
-                    code="TDA000", path=ctx.path, line=s.comment_line,
-                    col=0,
-                    message=(
-                        "suppression without a reason — write "
-                        "'# tda: ignore[CODE] -- why it is safe' "
-                        "(an unexplained ignore is unreviewable)"),
-                    snippet=ctx.lines[s.comment_line - 1].strip()
-                    if s.comment_line <= len(ctx.lines) else ""))
-        for line, msg in ctx.markers.malformed:
-            kept.append(Violation(
-                code="TDA000", path=ctx.path, line=line, col=0,
-                message=msg,
-                snippet=ctx.lines[line - 1].strip()
-                if line <= len(ctx.lines) else ""))
+        kept.extend(marker_violations(ctx))
     return sorted(kept, key=lambda v: (v.line, v.col, v.code))
 
 

@@ -11,6 +11,11 @@ applied:
     (``-- TODO: justify this suppression``). The scaffolded TODO counts
     as reason text, so the suppression takes effect immediately — but
     the TODO is grep-able and marks it for review.
+  * TDA000 (unused suppression): a reasoned pin that suppresses zero
+    findings is dead weight that could mask a future regression —
+    remove the comment (the whole line when it stood alone, just the
+    trailing comment otherwise). Nothing was being suppressed, so the
+    removal cannot surface new findings.
 
 Everything else (hoisting a host sync, adding a lock, routing a write
 through a seam) changes semantics and stays a human's job.
@@ -24,6 +29,8 @@ import re
 from tpu_distalg.analysis.concurrency import _is_thread_call
 
 _IGNORE_BARE_RE = re.compile(r"(tda:\s*ignore\[[A-Z0-9,\s]+\])\s*$")
+_IGNORE_COMMENT_RE = re.compile(
+    r"\s*#\s*tda:\s*ignore\[[A-Z0-9,\s]*\].*$")
 
 TODO_REASON = "TODO: justify this suppression"
 
@@ -86,6 +93,37 @@ def fix_source(source: str, violations) -> tuple[str, int]:
                                        else "")
             if _IGNORE_BARE_RE.search(lines[idx].rstrip("\n")):
                 edits.append((idx, scaffold))
+        elif v.code == "TDA000" and \
+                "suppresses no findings" in v.message:
+            idx = v.line - 1
+            if idx >= len(lines):
+                continue
+            stripped = lines[idx].strip()
+
+            def drop(s):
+                if s.strip().startswith("#"):
+                    return ""          # an own-line pin: delete it
+                out = _IGNORE_COMMENT_RE.sub("", s.rstrip("\n"))
+                return out + ("\n" if s.endswith("\n") else "")
+            if stripped.startswith("#") or \
+                    _IGNORE_COMMENT_RE.search(lines[idx]):
+                edits.append((idx, drop))
+                if not stripped.startswith("#"):
+                    continue
+                # an own-line pin's reason often wraps onto following
+                # comment lines at the same indent — they are part of
+                # the pin, not standalone prose; delete them too
+                # (stop at code, a blank line, a different indent, or
+                # a new tda: marker; trailing pins are left alone — a
+                # comment under one is usually unrelated)
+                indent = lines[idx][:len(lines[idx])
+                                    - len(lines[idx].lstrip())]
+                j = idx + 1
+                while j < len(lines) \
+                        and "tda:" not in lines[j] \
+                        and lines[j].startswith(indent + "#"):
+                    edits.append((j, lambda s: ""))
+                    j += 1
 
     n = 0
     for idx, fn in sorted(edits, key=lambda e: -e[0]):

@@ -386,6 +386,13 @@ class Coordinator:
             "commit_digests": [[w, s, d] for (w, s), d
                                in self.commit_digests.items()],
             "slots": {
+                # tda: ignore[TDA100] -- last_beat/suspect_at/
+                # conn_serial/stats are PER-INCARNATION state and must
+                # NOT be resurrected: a recovered slot gets a FRESH
+                # liveness clock (see _apply_wal_records), connection
+                # ownership dies with the old process's sockets, and
+                # worker stats re-ride the bye frames; pushes roll
+                # forward from replayed WAL push records instead
                 str(i): {"status": st.status, "admit": st.admit,
                          "incarnation": st.incarnation,
                          "delivered": st.delivered,

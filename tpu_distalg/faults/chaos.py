@@ -148,6 +148,11 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
     if workload in ("lr", "ssgd", "ssp"):
         return {"w": np.asarray(res.w), "accs": np.asarray(res.accs)}
     if workload == "cluster":
+        # tda: ignore[TDA100] -- not a checkpoint payload: this is the
+        # bitwise-COMPARE surface, and recoveries/recovery_ms are
+        # deliberately outside it (wall clock legitimately differs
+        # between the disturbed and undisturbed runs — see
+        # ClusterChaosResult's docstring)
         return {"center_w": np.asarray(res.center_w),
                 "event_digest": np.asarray(res.event_digest)}
     if workload in ("kmeans", "kmeans_stream"):

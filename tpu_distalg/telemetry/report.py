@@ -357,6 +357,35 @@ def render(s: dict) -> str:
 # dirs: DIR/coordinator + DIR/worker-N)
 PER_WORKER_PREFIXES = ("ssp.", "cluster.")
 
+# The TDA102 waiver table: every counter/gauge emitted anywhere in the
+# library must either appear in a renderer above, match a per-worker
+# family, or be listed HERE — an explicit statement that the generic
+# "counters:"/"gauges:" lines are its whole story (no derived summary
+# line owed). A `family.*` entry waives a prefix, including f-string
+# names like the per-code `lint.TDAxxx` counters. Adding a counter
+# without deciding its rendering is exactly the drift TDA102 exists
+# to stop — extend a renderer or extend this table, on purpose.
+SUMMARY_ONLY_COUNTERS = (
+    "checkpoints_saved",        # rendered via the checkpoint_saved
+    #                             event count, not the counter
+    "restarts",                 # ditto: the restart event line
+    "quarantines",
+    "preemptions",
+    "closure.capacity_regrows",
+    "data.*",                   # gather/h2d byte+batch bookkeeping
+    "faults.*",                 # the fault table reads the events
+    "graph.ingest_edges",
+    "graph.edges_streamed",
+    "lint.*",                   # per-code counts + files/cached/
+    #                             graph_seconds; the span carries time
+    "serve.artifact_reread",
+    "serve.failed_batches",
+    "serve.merge_bytes_wire",
+    "spmv_plan_rejections",
+    "reshard.bytes_logical",    # the reshard line renders wire/host;
+    #                             logical is accounting input only
+)
+
 
 def _natural_key(path: str):
     """Numeric-aware sort key: ``worker-10`` sorts after ``worker-9``,
