@@ -623,7 +623,100 @@ def test_tda051_negative_native_ring_and_scope():
         q = jnp.clip(jnp.floor(x / scale + u), -127, 127)
         return lax.psum(q.astype(jnp.int32), axis)
     """
-    assert lint(widened, path=LIB) == []  # parallel/ only
+    assert lint(widened, path=LIB) == []  # parallel/ + cluster/ only
+
+
+CLUSTER = "tpu_distalg/cluster/somewire.py"
+
+
+def test_tda051_cluster_widening_onto_transport_flagged():
+    """The cluster-wire twin of the int32-psum regression: a host-
+    quantized buffer widened as it enters the framed TCP transport —
+    the wire moves 4 bytes/elem while cluster_wire_reduction_vs_dense
+    claims 1. Both transport spellings (send_frame under any root,
+    raw socket sendall) are policed."""
+    src = """
+    import numpy as np
+    from tpu_distalg.cluster import transport
+
+    def push(sock, x, scale, u):
+        q = np.clip(np.floor(x / scale + u), -127, 127) \
+            .astype(np.int8)
+        transport.send_frame(sock, "push", {"w": 0},
+                             {"q": q.astype(np.float32)})
+    """
+    vs = lint(src, path=CLUSTER)
+    assert codes(vs) == ["TDA051"]
+    assert "float32" in vs[0].message
+    raw_sock = """
+    import numpy as np
+
+    def push(sock, x, scale, u):
+        q = np.clip(np.floor(x / scale + u), -127, 127)
+        sock.sendall(q.astype(np.int32).tobytes())
+    """
+    # (TDA090 also legitimately flags the raw-socket spelling — the
+    # widening rule must fire REGARDLESS of which send idiom hid it)
+    assert "TDA051" in codes(lint(raw_sock, path=CLUSTER))
+
+
+def test_tda051_cluster_native_and_scope_negative():
+    """The native host-codec pattern is clean: int8 rides the frame,
+    the exact int32 widening happens on the RECEIVED buffer (the PS
+    decode, after the wire); and the same widening-into-send_frame
+    outside tpu_distalg/cluster/ is out of scope."""
+    native = """
+    import numpy as np
+    from tpu_distalg.cluster import transport
+
+    def push(sock, x, scale, u):
+        q = np.clip(np.floor(x / scale + u), -127, 127) \
+            .astype(np.int8)
+        transport.send_frame(sock, "push", {"w": 0},
+                             {"q": q, "scale": scale})
+
+    def decode(arrays, scale):
+        q = arrays["q"]
+        return q.astype(np.int32).astype(np.float32) * scale
+    """
+    assert lint(native, path=CLUSTER) == []
+    outside = """
+    import numpy as np
+    from tpu_distalg.cluster import transport
+
+    def push(sock, x, scale, u):
+        q = np.clip(np.floor(x / scale + u), -127, 127)
+        transport.send_frame(sock, "push", {"w": 0},
+                             {"q": q.astype(np.float32)})
+    """
+    assert lint(outside, path=LIB) == []
+
+
+def test_tda051_real_tree_and_baseline_stay_clean():
+    """The shipped parallel/ + cluster/ trees carry no TDA051
+    violations and none are baselined away — the rule extension must
+    not land with suppressed debt."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_distalg.analysis.cli",
+         "--select", "TDA051", "--format", "json",
+         os.path.join(root, "tpu_distalg", "parallel"),
+         os.path.join(root, "tpu_distalg", "cluster")],
+        capture_output=True, text=True, cwd=root, timeout=120)
+    out = json.loads(r.stdout) if r.stdout.strip() else []
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-500:])
+    assert out == [] or all(
+        v.get("code") != "TDA051" for v in out), out
+    with open(os.path.join(root, "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert not [e for e in (baseline if isinstance(baseline, list)
+                            else baseline.get("violations", []))
+                if "TDA051" in json.dumps(e)]
 
 
 # ---------------------------------------------------------------- TDA060

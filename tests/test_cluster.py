@@ -391,10 +391,11 @@ CFG = dict(n_slots=3, n_windows=8, staleness=3, heartbeat_timeout=3.0,
 
 
 def _run(plan=None, policy="elastic", n_slots=3, n_windows=8,
-         checkpoint_dir=None, heartbeat_timeout=None, **kw):
+         checkpoint_dir=None, heartbeat_timeout=None, comm="dense",
+         **kw):
     over = {
         **CFG, "n_slots": n_slots, "n_windows": n_windows,
-        "plan_spec": plan, "policy": policy,
+        "plan_spec": plan, "policy": policy, "comm": comm,
         "checkpoint_dir": checkpoint_dir}
     if heartbeat_timeout is not None:
         # the coordinator-kill scenarios use a GENEROUS timeout:
@@ -481,18 +482,33 @@ def test_cluster_restart_policy_is_the_gang_scheduled_baseline(
 
 
 def test_cluster_join_one_late():
-    # spawn only 2 of 3 slots; the third joins mid-run, unsolicited
+    """Spawn only 2 of 3 slots; the third joins mid-run, unsolicited.
+
+    PR 14's tier-1 run recorded this as a LOAD-TIMING flake: the old
+    spelling raced wall clock — spawn w2 once ``version >= 3`` and
+    hope the clock hadn't moved past the deadline budget on a loaded
+    box (two workers paying jax compiles could eat the whole 60 s
+    before window 3, and nothing stopped the clock at 3 either). The
+    deterministic spelling pins the rendezvous with an ADMISSION HOLD
+    (the launcher's own replay mechanism): the commit of window 3
+    cannot proceed until all 3 slots are active, so the clock STALLS
+    at exactly version 3 until w2 joins — no race in either
+    direction, under any load. The deadline below only bounds two
+    workers training 3 windows."""
     cfg = clus.ClusterConfig(**{**CFG, "n_windows": 10})
     coord = clus.Coordinator(cfg).start()
     try:
         from tpu_distalg.cluster.local import _ThreadWorker
 
+        coord.hold_admission(3, 3)
         w0 = _ThreadWorker("127.0.0.1", coord.port, 0)
         w1 = _ThreadWorker("127.0.0.1", coord.port, 1)
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while coord.version < 3 and time.monotonic() < deadline:
             time.sleep(0.01)
-        assert coord.version >= 3
+        # the hold makes this exact, not least-upper-bound: version
+        # can never pass 3 without the third slot active
+        assert coord.version == 3
         w2 = _ThreadWorker("127.0.0.1", coord.port, 2)
         res = coord.wait(timeout=120.0)
         for w in (w0, w1, w2):
@@ -503,7 +519,7 @@ def test_cluster_join_one_late():
     joins = [e for e in res["membership_sequence"]
              if e[0] == "join"]
     late = [e for e in joins if e[1] == 2]
-    assert late and late[0][2] >= 3        # admitted mid-run
+    assert late and late[0][2] == 3        # admitted exactly at the hold
     # it participates in every window from its admission on
     admit = late[0][2]
     for w, applied, _ in res["merge_sequence"]:
@@ -879,6 +895,310 @@ def test_report_renders_recovery_line_and_worker_columns():
          "workers": {"worker-0": treport.summarize(evts)}})
 
 
+def test_report_renders_cluster_wire_line():
+    from tpu_distalg.telemetry import report as treport
+
+    evts = [{"ev": "counters", "counters": {
+        "cluster.wire_push_bytes": 2_500_000,
+        "cluster.wire_center_bytes": 1_500_000,
+        "cluster.delta_pulls": 24,
+        "cluster.pull_dense_fallbacks": 3,
+        "cluster.async_pushes": 24}}]
+    out = treport.render(treport.summarize(evts))
+    assert ("cluster wire: 2.50 MB pushed / 1.50 MB pulled "
+            "(24 delta pull(s), 3 dense fallback(s), 24 overlapped "
+            "push(es))") in out
+    # small runs render KB, never a misleading "0.00 MB"
+    evts_small = [{"ev": "counters", "counters": {
+        "cluster.wire_push_bytes": 5_200,
+        "cluster.wire_center_bytes": 3_100}}]
+    assert "5.2 KB pushed / 3.1 KB pulled" in treport.render(
+        treport.summarize(evts_small))
+
+
+# ------------------------------------------- compressed cluster wire
+
+
+def test_transport_parts_join_is_the_frame():
+    """The scatter-gather satellite's framing pin: the buffer list
+    send_frame hands to sendmsg concatenates to EXACTLY the
+    contiguous encode_frame bytes — one framing implementation, zero
+    drift, and the numpy-fallback sendall path is byte-identical by
+    construction."""
+    arrays = {"q": np.arange(64, dtype=np.int8),
+              "scale": np.full((1,), 0.25, np.float32),
+              "idx": np.array([5, 1], np.int32)}
+    meta = {"slot": 1, "window": 4, "have": 3}
+    parts = transport.encode_frame_parts("push", meta, arrays)
+    assert len(parts) == 1 + len(arrays)   # prefix+header, then chunks
+    assert b"".join(parts) == transport.encode_frame("push", meta,
+                                                     arrays)
+    # and the joined bytes parse back losslessly
+    a, b = _pipe()
+    transport.send_frame(a, "push", meta, arrays)
+    kind, m, out = transport.recv_frame(b, deadline=5.0)
+    assert kind == "push" and m == meta
+    for k, v in arrays.items():
+        assert out[k].dtype == v.dtype and np.array_equal(out[k], v)
+    a.close(), b.close()
+
+
+def test_transport_wire_stats_measure_real_frame_bytes():
+    a, b = _pipe()
+    transport.wire_stats_reset()
+    arrays = {"w": np.ones(100, np.float32)}
+    n = len(transport.encode_frame("push", {"x": 1}, arrays))
+    transport.send_frame(a, "push", {"x": 1}, arrays)
+    transport.send_frame(a, "center", {}, arrays)
+    st = transport.wire_stats()
+    assert st["push"] == {"frames": 1, "bytes": n}
+    assert st["center"]["frames"] == 1
+    transport.wire_stats_reset()
+    assert transport.wire_stats() == {}
+    a.close(), b.close()
+
+
+def test_host_codec_ef_residual_resume_round_trip():
+    """The EF-residual resume satellite, unit level: serialize the
+    residual mid-stream (what a checkpointed worker state carries),
+    restore it, and the continuation emits BITWISE the bytes of the
+    uninterrupted stream — the residual is the ONLY cross-window
+    codec state, so this is the whole resume story."""
+    from tpu_distalg.parallel import comms
+
+    rng = np.random.RandomState(3)
+    deltas = [rng.randn(96).astype(np.float32) for _ in range(6)]
+    for spec in ("int8:9", "topk:0.25"):
+        codec = comms.make_host_codec(spec)
+        template = {"w": np.zeros(96, np.float32)}
+
+        def stream(residuals, start, stop, out):
+            for w in range(start, stop):
+                arrays, residuals = comms.encode_tree(
+                    codec, {"w": deltas[w]}, residuals,
+                    comms.PUSH_SEED_TAG, 0, w)
+                out.append(arrays)
+            return residuals
+
+        # uninterrupted
+        full: list = []
+        stream(comms.zero_residuals(template), 0, 6, full)
+        # interrupted at window 3: residual round-trips through bytes
+        # (the checkpoint spelling — np.save/load of the flat vector)
+        first: list = []
+        res = stream(comms.zero_residuals(template), 0, 3, first)
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, res["w"])
+        buf.seek(0)
+        resumed = {"w": np.load(buf)}
+        stream(resumed, 3, 6, first)
+        assert len(first) == len(full)
+        for a, b in zip(first, full):
+            assert sorted(a) == sorted(b)
+            for k in a:
+                assert np.array_equal(a[k], b[k]), (spec, k)
+
+
+def test_cluster_dense_is_pinned_to_the_pre_compression_protocol(
+        undisturbed):
+    """--comm dense IS the pre-PR cluster: codec None (the verbatim
+    f32 snapshot path, no 'have'/'mode' machinery), and the full run
+    reproduces the undisturbed fixture bitwise — sequences, center,
+    accuracy."""
+    from tpu_distalg.parallel import comms
+
+    assert comms.make_host_codec("dense") is None
+    res = _run(comm="dense")
+    assert res["merge_sequence"] == undisturbed["merge_sequence"]
+    assert res["membership_sequence"] == \
+        undisturbed["membership_sequence"]
+    assert np.array_equal(res["center"]["w"],
+                          undisturbed["center"]["w"])
+    assert res["accuracy"] == undisturbed["accuracy"]
+
+
+def test_cluster_rejects_deviceless_schedules():
+    with pytest.raises(ValueError, match="host-wire codec"):
+        clus.ClusterConfig(**{**CFG, "comm": "bucketed"})
+
+
+@pytest.fixture(scope="module", params=["int8:5", "topk:0.25"])
+def compressed_undisturbed(request):
+    return request.param, _run(comm=request.param)
+
+
+def test_compressed_wire_converges_and_compresses(
+        compressed_undisturbed, undisturbed):
+    """The compressed run completes, converges inside the SSP chaos
+    band of dense, rides version-delta pulls (no dense fallbacks
+    after the welcome), and overlaps every push."""
+    comm, res = compressed_undisturbed
+    assert res["version"] == 8
+    assert abs(res["accuracy"]
+               - undisturbed["accuracy"]) <= SSP_CHAOS_ACC_BAND
+    for s in res["worker_stats"].values():
+        assert s["pushes"] == 8
+        assert s["delta_pulls"] == 8      # every ack rode a delta
+        assert s["dense_pulls"] == 0
+        assert s["async_pushes"] == 8     # the overlap was on
+
+
+def test_compressed_seq_spelling_disables_the_overlap():
+    res = _run(comm="int8:5@seq")
+    assert res["version"] == 8
+    for s in res["worker_stats"].values():
+        assert s["async_pushes"] == 0
+        assert s["delta_pulls"] == 8      # compression itself stays on
+
+
+def test_compressed_chaos_grid_coordinator_kill_bitwise(
+        compressed_undisturbed, tmp_path):
+    """Grid row 1 — compression × coordinator kill -9: WAL rollback,
+    recovery, worker reconnect + re-push of the identical COMPRESSED
+    bytes, version-delta pulls re-served from the replay-rebuilt
+    center history. Verdict: bitwise center + identical sequences vs
+    the undisturbed run of the same wire schedule."""
+    comm, und = compressed_undisturbed
+    res = _run(plan="seed=7;cluster:coordinator@4=kill", comm=comm,
+               checkpoint_dir=str(tmp_path), heartbeat_timeout=15.0)
+    assert res["version"] == 8
+    assert res["coordinator_recoveries"] == 1
+    assert res["merge_sequence"] == und["merge_sequence"]
+    assert res["membership_sequence"] == und["membership_sequence"]
+    assert np.array_equal(res["center"]["w"], und["center"]["w"])
+    # recovery re-served DELTAS, not fallbacks: the rebuilt history
+    # covered every re-pushed window
+    assert all(s["dense_pulls"] == 0
+               for s in res["worker_stats"].values())
+
+
+def test_compressed_chaos_grid_rpc_oserror_bitwise(
+        compressed_undisturbed):
+    """Grid row 2 — compression × cluster:rpc oserror (a torn
+    connection mid-run): the link resumes and re-delivers the same
+    frames; pulls stay version-pinned, so even the re-served acks are
+    bitwise. Verdict: identical center + sequences vs undisturbed."""
+    comm, und = compressed_undisturbed
+    plan = "seed=11;cluster:rpc@40=oserror"
+    faults.configure(plan)     # a LIVE seam, not a compiled schedule
+    try:
+        res = _run(plan=plan, comm=comm)
+    finally:
+        faults.configure(False)
+    assert res["version"] == 8
+    assert res["merge_sequence"] == und["merge_sequence"]
+    assert res["membership_sequence"] == und["membership_sequence"]
+    assert np.array_equal(res["center"]["w"], und["center"]["w"])
+
+
+def test_compressed_chaos_grid_worker_kill_rejoin_replays(
+        compressed_undisturbed, undisturbed):
+    """Grid row 3 — compression × worker kill + pinned rejoin: the
+    membership legitimately differs from undisturbed (that is the
+    kill), so the verdict is REPLAY bitwiseness (same plan ⇒ same
+    digest + center) plus convergence inside the chaos band; the
+    rejoiner's fresh admission takes the dense-snapshot pull
+    fallback by construction."""
+    comm, und = compressed_undisturbed
+    plan = "seed=7;cluster:worker@10=kill"
+    a = _run(plan=plan, comm=comm, rejoin_after=2)
+    b = _run(plan=plan, comm=comm, rejoin_after=2)
+    assert a["version"] == 8 and a["respawns"] == 1
+    assert a["merge_sequence"] == b["merge_sequence"]
+    assert a["membership_sequence"] == b["membership_sequence"]
+    assert np.array_equal(a["center"]["w"], b["center"]["w"])
+    assert ("leave", 1, 3) in a["membership_sequence"]
+    assert ("join", 1, 5) in a["membership_sequence"]
+    assert abs(a["accuracy"]
+               - undisturbed["accuracy"]) <= SSP_CHAOS_ACC_BAND
+
+
+def test_version_delta_pull_falls_back_to_snapshot(tmp_path):
+    """The fallback satellite, protocol level: a push whose ``have``
+    predates the PS history window is answered with a DENSE
+    version-pinned snapshot instead of an unservable delta — and a
+    recovered coordinator whose rebuilt history lacks the requested
+    base does the same rather than guessing."""
+    cfg = clus.ClusterConfig(**{
+        **CFG, "n_slots": 1, "n_windows": 6, "comm": "int8:5",
+        "checkpoint_dir": str(tmp_path), "heartbeat_timeout": 30.0})
+    coord = clus.Coordinator(cfg).start()
+    try:
+        sock = transport.connect("127.0.0.1", coord.port)
+        kind, meta, center = transport.request(sock, "join",
+                                               {"slot": 0})
+        assert kind == "welcome" and meta["comm"] == "int8:5"
+        ident = {"slot": 0, "inc": int(meta["incarnation"])}
+        from tpu_distalg.parallel import comms
+
+        codec = comms.make_host_codec("int8:5")
+        delta = {"w": np.full_like(center["w"], 0.125)}
+        arrays, _ = comms.encode_tree(codec, delta, None,
+                                      comms.PUSH_SEED_TAG, 0, 0)
+        # have = -1: nothing cached (no such version in history)
+        k, m, arrs = transport.request(
+            sock, "push", dict(ident, window=0, base=0, have=-1),
+            arrays)
+        assert k == "center" and m["mode"] == "dense"
+        assert int(m["cv"]) == 1
+        assert arrs["w"].dtype == np.float32     # a real snapshot
+        # a served base inside the history rides a delta
+        arrays2, _ = comms.encode_tree(codec, delta, None,
+                                       comms.PUSH_SEED_TAG, 0, 1)
+        k2, m2, arrs2 = transport.request(
+            sock, "push", dict(ident, window=1, base=1, have=1),
+            arrays2)
+        assert k2 == "center" and m2["mode"] == "delta"
+        assert int(m2["cv"]) == 2 and int(m2["have"]) == 1
+        assert arrs2["w.q"].dtype == np.int8     # compressed wire
+        sock.close()
+    finally:
+        coord.stop()
+
+
+def test_pull_refresh_cadence_bounds_view_drift():
+    """Review pin: pull-direction rounding noise has no EF channel,
+    so every PULL_REFRESH_WINDOWS-th commit ships a dense
+    version-pinned snapshot — the worker's cached-view random walk is
+    bounded by the refresh period, and the cadence is a pure function
+    of cv (replay-inert). A long compressed run really takes them."""
+    from tpu_distalg.cluster.coordinator import PULL_REFRESH_WINDOWS
+
+    windows = PULL_REFRESH_WINDOWS + 2
+    res = _run(comm="int8:5", n_slots=1, n_windows=windows)
+    assert res["version"] == windows
+    s = res["worker_stats"][0]
+    assert s["pushes"] == windows
+    # exactly one scheduled refresh in the range (cv = REFRESH), the
+    # rest deltas
+    assert s["dense_pulls"] == 1
+    assert s["delta_pulls"] == windows - 1
+
+
+def test_wal_commit_records_carry_the_compressed_bytes(tmp_path):
+    """The redo log logs what crossed the wire: under a codec the
+    commit record's arrays are the int8/pair payloads (replayed
+    bitwise through the same decode), never a re-densified copy."""
+    res = _run(comm="int8:5", n_windows=4,
+               checkpoint_dir=str(tmp_path), heartbeat_timeout=15.0)
+    assert res["version"] == 4
+    wal_dir = os.path.join(str(tmp_path), "wal")
+    recs = []
+    for b in wal.segment_bases(wal_dir):
+        segment, _ = wal.read_segment(wal._segment_path(wal_dir, b))
+        recs.extend(segment)
+    commits = [r for r in recs if r[0] == "commit"]
+    assert commits
+    for _k, meta, arrays in commits:
+        for c in meta["contribs"]:
+            q = arrays[f"{c['slot']}/w.q"]
+            assert q.dtype == np.int8
+            assert f"{c['slot']}/w.scale" in arrays
+            assert f"{c['slot']}/w" not in arrays
+
+
 # --------------------------------------------- subprocess acceptance
 
 
@@ -961,7 +1281,7 @@ def test_subprocess_grid_straggle_and_rpc_partition(tmp_path):
 # ----------------------------------------------------- bench contract
 
 
-def test_cluster_bench_fast_mode_emits_all_three_metrics():
+def test_cluster_bench_fast_mode_emits_all_four_metrics():
     import bench
 
     lines = []
@@ -969,14 +1289,43 @@ def test_cluster_bench_fast_mode_emits_all_three_metrics():
     by = {ln["metric"]: ln for ln in lines}
     assert set(by) == {"ssgd_cluster_elastic_speedup",
                        "cluster_push_pull_ms",
-                       "cluster_coordinator_recovery_ms"}
+                       "cluster_coordinator_recovery_ms",
+                       "cluster_wire_reduction_vs_dense"}
     assert by["ssgd_cluster_elastic_speedup"]["value"] > 0
     assert by["cluster_push_pull_ms"]["value"] > 0
     assert by["ssgd_cluster_elastic_speedup"]["elastic_final_acc"] > .6
+    # the measured arms run under the canonical compressed wire
+    assert by["cluster_push_pull_ms"]["comm"] == \
+        bench.CLUSTER_BENCH_COMM
     rec = by["cluster_coordinator_recovery_ms"]
     assert rec["value"] > 0
     assert rec["bitwise_vs_undisturbed"] is True
     assert len(rec["recovery_ms_all"]) == rec["kills"]
+    wire = by["cluster_wire_reduction_vs_dense"]
+    # the acceptance floor: >= 3.0x measured frame bytes at the
+    # canonical worker count, convergence inside the band (enforced
+    # by raise inside the bench; the accuracies ride the line)
+    assert wire["value"] >= 3.0
+    assert wire["push_reduction"] > 1.0
+    assert wire["pull_reduction"] > 1.0
+    assert wire["n_workers"] == bench.CLUSTER_SLOTS
+
+
+def test_cluster_wire_bench_off_canonical_suffixes():
+    """Off-canonical comm/worker geometries record under suffixed
+    names so the canonical claim metric never ingests them (TDA102
+    name<->emission bijectivity) — checked statically on the suffix
+    logic, not by paying two more cluster runs."""
+    import bench
+    from tpu_distalg.parallel import comms as pcomms
+
+    sched = pcomms.CommSpec.parse("topk:0.05").schedule
+    assert sched == "topk"
+    # mirror of run_cluster_wire_bench's suffix rule
+    assert "cluster_wire_reduction_vs_dense" in \
+        bench.ALL_METRIC_NAMES
+    assert "cluster_wire_reduction_vs_dense_topk" not in \
+        bench.ALL_METRIC_NAMES
 
 
 def test_cluster_metrics_registered_for_claims_and_fallback():
@@ -989,10 +1338,15 @@ def test_cluster_metrics_registered_for_claims_and_fallback():
     tc.assert_registered(
         ("ssgd_cluster_elastic_speedup",
          "cluster_push_pull_ms",
-         "cluster_coordinator_recovery_ms"),
+         "cluster_coordinator_recovery_ms",
+         "cluster_wire_reduction_vs_dense"),
         os.path.dirname(os.path.abspath(bench.__file__)))
     assert "cluster_push_pull_ms" in bench.LOWER_IS_BETTER_METRICS
     assert "cluster_coordinator_recovery_ms" in \
+        bench.LOWER_IS_BETTER_METRICS
+    # wire reduction is higher-is-better: must NOT be in the
+    # lower-is-better set or the tripwire would flag improvements
+    assert "cluster_wire_reduction_vs_dense" not in \
         bench.LOWER_IS_BETTER_METRICS
     import sys
 
@@ -1003,8 +1357,10 @@ def test_cluster_metrics_registered_for_claims_and_fallback():
     claimed = {m for m, _, _ in crc.CLAIMS}
     assert {"ssgd_cluster_elastic_speedup",
             "cluster_push_pull_ms",
-            "cluster_coordinator_recovery_ms"} <= claimed
+            "cluster_coordinator_recovery_ms",
+            "cluster_wire_reduction_vs_dense"} <= claimed
     assert "ssgd_cluster_elastic_speedup" in crc.FLOOR_CLAIMS
+    assert "cluster_wire_reduction_vs_dense" in crc.FLOOR_CLAIMS
     assert "cluster_push_pull_ms" in crc.CEILING_CLAIMS
     assert "cluster_coordinator_recovery_ms" in crc.CEILING_CLAIMS
     readme = os.path.join(os.path.dirname(os.path.dirname(
@@ -1014,3 +1370,4 @@ def test_cluster_metrics_registered_for_claims_and_fallback():
     assert "ssgd_cluster_elastic_speedup" in claims
     assert "cluster_push_pull_ms" in claims
     assert "cluster_coordinator_recovery_ms" in claims
+    assert "cluster_wire_reduction_vs_dense" in claims

@@ -601,3 +601,85 @@ def test_overlap_counters_render_efficiency_line(tmp_path):
     rendered = treport.render(summary)
     assert "comm overlap: 300 ms hidden behind compute" in rendered
     assert "75% of 400 ms comm time" in rendered
+
+
+# -------------------------------------------------- host wire codecs
+
+
+def test_host_codec_int8_deterministic_unbiased_and_exact_decode():
+    """The cluster wire's int8 stage: same (seed, path) ⇒ identical
+    bytes; different path ⇒ different rounding noise; decode widens
+    int8→int32 exactly before the one scale multiply; stochastic
+    rounding is unbiased over repeats."""
+    codec = comms.make_host_codec("int8:7")
+    x = np.random.RandomState(0).randn(512).astype(np.float32)
+    a1, _ = codec.encode(x, None, 1, 0, 3)
+    a2, _ = codec.encode(x, None, 1, 0, 3)
+    assert np.array_equal(a1["q"], a2["q"])
+    assert np.array_equal(a1["scale"], a2["scale"])
+    a3, _ = codec.encode(x, None, 1, 0, 4)
+    assert not np.array_equal(a1["q"], a3["q"])
+    assert a1["q"].dtype == np.int8
+    dec = codec.decode(a1, 512)
+    scale = float(a1["scale"][0])
+    assert np.abs(dec - x).max() <= scale + 1e-7
+    # unbiased: mean reconstruction error over many seeded paths ~ 0
+    errs = []
+    for p in range(64):
+        a, _ = codec.encode(x, None, 1, 0, p)
+        errs.append((codec.decode(a, 512) - x).mean())
+    assert abs(float(np.mean(errs))) < scale / 4
+
+
+def test_host_codec_topk_pairs_and_error_feedback():
+    """topk keeps the k largest-|.| of (delta + residual) as (value,
+    index) pairs, scatter-adds exactly on decode, and the residual
+    carries everything unsent — over windows nothing is lost (EF-SGD:
+    the sums telescope)."""
+    codec = comms.make_host_codec("topk:0.25")
+    d = 64
+    rng = np.random.RandomState(1)
+    res = np.zeros(d, np.float32)
+    sent_total = np.zeros(d, np.float32)
+    pushed_total = np.zeros(d, np.float32)
+    for w in range(8):
+        delta = rng.randn(d).astype(np.float32)
+        pushed_total += delta
+        arrays, res = codec.encode(delta, res, 1, 0, w)
+        assert arrays["vals"].shape == (16,)        # 0.25 * 64
+        assert arrays["idx"].dtype == np.int32
+        sent_total += codec.decode(arrays, d)
+    # telescoping EF invariant: sent + residual == everything pushed
+    np.testing.assert_allclose(sent_total + res, pushed_total,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_host_codec_tree_round_trip_and_schedule_gate():
+    codec = comms.make_host_codec(comms.CommSpec.parse("int8:5"))
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(5, np.float32)}
+    arrays, resd = comms.encode_tree(
+        codec, tree, comms.zero_residuals(tree), 2, 1, 0, 7)
+    assert set(arrays) == {"w.q", "w.scale", "b.q", "b.scale"}
+    out = comms.decode_tree(codec, arrays, tree)
+    assert out["w"].shape == (3, 4) and out["b"].shape == (5,)
+    assert np.abs(out["w"] - tree["w"]).max() < 0.1
+    assert sorted(resd) == ["b", "w"]
+    # device-only schedules have no host spelling — refused, named
+    with pytest.raises(ValueError, match="host-wire codec"):
+        comms.make_host_codec("hier")
+    assert comms.make_host_codec("dense") is None
+
+
+def test_host_pull_codec_is_int8_under_every_compressed_mode():
+    """Review pin: pulls ride the int8 codec under BOTH compressed
+    modes — topk pairs on the pull direction would silently lose the
+    untransmitted (1−frac) of every center delta from the worker's
+    cached view (no residual channel exists coordinator-side)."""
+    assert comms.make_host_pull_codec("dense") is None
+    assert isinstance(comms.make_host_pull_codec("int8:7"),
+                      comms.Int8HostCodec)
+    assert isinstance(comms.make_host_pull_codec("topk:0.25"),
+                      comms.Int8HostCodec)
+    # and the seed rides through, so both ends derive the same stream
+    assert comms.make_host_pull_codec("int8:7").spec.seed == 7

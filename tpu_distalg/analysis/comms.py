@@ -73,6 +73,18 @@ class RawCollectiveInModels(Rule):
 _WIRE_OPS = ("psum", "pmean", "pmax", "pmin", "psum_scatter",
              "ppermute", "all_to_all", "all_gather")
 
+#: the CLUSTER tier's wire entry points (tpu_distalg/cluster/): a
+#: frame handed to any of these goes byte-for-byte onto the TCP
+#: socket, so a quantized buffer widened on its way in is the same
+#: regression at the process boundary — the host codec's int8/pair
+#: payload silently re-inflated to f32/int32 while
+#: cluster_wire_reduction_vs_dense claims the compressed size.
+#: Matched by call TAIL under any root (``transport.send_frame``, a
+#: bare imported ``send_frame``, ``sock.sendall``/``sendmsg``).
+_CLUSTER_WIRE_OPS = ("send_frame", "encode_frame",
+                     "encode_frame_parts", "request", "sendall",
+                     "sendmsg")
+
 #: dtypes wider than int8 — casting a quantized buffer to any of these
 #: before the collective silently reintroduces the int32-psum wire
 _WIDER_THAN_INT8 = frozenset((
@@ -121,17 +133,32 @@ def _is_quantize_expr(node) -> bool:
 class WideningCastOntoWire(Rule):
     code = "TDA051"
     name = "quantized buffer widened on its way into a collective"
-    invariant = ("in tpu_distalg/parallel/, a buffer produced by "
+    invariant = ("in tpu_distalg/parallel/ a buffer produced by "
                  "quantization (astype(int8) or the clip(floor(...)) "
-                 "idiom) enters collectives at wire precision — a "
-                 "dtype-widening .astype() between the quantize and "
-                 "the collective call re-inflates the payload to "
-                 "int32/f32 on the wire while the byte accounting "
+                 "idiom) enters collectives at wire precision, and in "
+                 "tpu_distalg/cluster/ it enters the framed TCP "
+                 "transport (send_frame/encode_frame/request/sendall) "
+                 "at wire precision — a dtype-widening .astype() "
+                 "between the quantize and the wire call re-inflates "
+                 "the payload to int32/f32 while the byte accounting "
                  "still claims the compressed size (the PR 5 "
-                 "int32-psum regression)")
+                 "int32-psum regression, and its cluster-wire twin)")
 
     def applies(self, ctx):
-        return "tpu_distalg/parallel/" in ctx.path
+        return ("tpu_distalg/parallel/" in ctx.path
+                or "tpu_distalg/cluster/" in ctx.path)
+
+    @staticmethod
+    def _is_wire_call(ctx, name: str) -> bool:
+        """A call that puts its arguments on a wire: the raw jax
+        collectives (parallel/ and cluster/ alike), plus — in
+        cluster/ files — the transport's framing/send entry points
+        under any root."""
+        parts = name.split(".")
+        if parts[-1] in _WIRE_OPS and parts[0] in _RAW_ROOTS:
+            return True
+        return ("tpu_distalg/cluster/" in ctx.path
+                and parts[-1] in _CLUSTER_WIRE_OPS)
 
     def check(self, ctx):
         # outermost defs only: _check_function walks nested closures
@@ -200,10 +227,7 @@ class WideningCastOntoWire(Rule):
             if not isinstance(node, ast.Call):
                 continue
             name = call_name(node)
-            if name is None:
-                continue
-            parts = name.split(".")
-            if parts[-1] not in _WIRE_OPS or parts[0] not in _RAW_ROOTS:
+            if name is None or not self._is_wire_call(ctx, name):
                 continue
             for arg in [*node.args,
                         *(kw.value for kw in node.keywords)]:

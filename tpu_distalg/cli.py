@@ -500,6 +500,16 @@ def main(argv=None):
                         "split across this many PS shards per the "
                         "model's partition rule table (uneven splits "
                         "are first-class)")
+    p.add_argument("--comm", default="dense", metavar="SCHED",
+                   help="cluster wire schedule: dense (f32 snapshots, "
+                        "the pre-compression protocol bit-for-bit), "
+                        "int8[:seed] (seeded stochastic rounding, "
+                        "~1 byte/elem both directions) or topk[:frac] "
+                        "((value,index) pairs with worker-side error "
+                        "feedback; pulls ride the int8 codec) — "
+                        "compressed pushes overlap the next window's "
+                        "compute on a background sender; append @seq "
+                        "to force synchronous pushes (e.g. int8@seq)")
     p.add_argument("--policy", default="elastic",
                    choices=["elastic", "restart"],
                    help="death handling: elastic = continue at "
@@ -573,6 +583,12 @@ def main(argv=None):
                         "of the in-process coordinator either way; "
                         "the genuine subprocess kill -9 is 'tda "
                         "cluster --coordinator-spawn process')")
+    p.add_argument("--comm", default="dense", metavar="SCHED",
+                   help="cluster workload only: the wire schedule "
+                        "both the undisturbed and the chaos run use "
+                        "(dense/int8[:seed]/topk[:frac]) — the "
+                        "compression×chaos composition acceptance is "
+                        "'tda chaos --workload cluster --comm int8'")
     p.add_argument("--workdir", type=str, default=None,
                    help="checkpoint scratch directory (default: a "
                         "fresh temp dir, removed on success)")
@@ -749,7 +765,8 @@ def _run_cluster(args):
         reconnect_grace=args.reconnect_grace,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
-        policy=args.policy, plan_spec=plan, train=train)
+        policy=args.policy, plan_spec=plan, comm=args.comm,
+        train=train)
     if args.role == "coordinator":
         coord = clus.Coordinator(cfg).start()
         print(f"cluster_coordinator: listening on "
@@ -1262,7 +1279,7 @@ def _dispatch(args, jax):
                 n_iterations=args.n_iterations,
                 checkpoint_every=args.checkpoint_every,
                 max_restarts=args.max_restarts,
-                spawn=args.spawn,
+                spawn=args.spawn, comm=args.comm,
                 logger=lambda m: print(f"[chaos] {m}"))
         finally:
             if made_tmp:

@@ -8,9 +8,15 @@ existing SGD-family trainers on their own local mesh; and a
 parameter-server tier applying staleness-weighted (``decay**age``)
 delta merges — all over a length-prefixed framed-numpy TCP transport
 (no pickle, a deadline on every blocking receive; TDA090 lints the
-discipline). A worker can genuinely die (``kill -9``), lag, join and
-leave while training continues at reduced quorum; the seeded fault
-plan (``cluster:worker`` / ``cluster:rpc`` points) makes a chaos run
+discipline). ``--comm {dense,int8[:seed],topk[:frac]}`` selects the
+WIRE schedule: compressed pushes (seeded stochastic int8 / top-k
+pairs with worker-side error feedback) overlap the next window's
+compute on a background sender, and pulls ship version-pinned
+compressed deltas against the worker's cached center (the host
+codecs of ``parallel/comms.py``). A worker can genuinely die
+(``kill -9``), lag, join and leave while training continues at
+reduced quorum; the seeded fault plan (``cluster:worker`` /
+``cluster:rpc`` points) makes a chaos run — compressed or dense —
 replay to the identical merge/membership event sequence.
 
 See ``docs/ARCHITECTURE.md`` ("Multi-process elastic runtime") and

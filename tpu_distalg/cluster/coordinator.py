@@ -75,6 +75,7 @@ from tpu_distalg.cluster import ps as psmod
 from tpu_distalg.cluster import transport
 from tpu_distalg.cluster import wal as walmod
 from tpu_distalg.faults import registry as fregistry
+from tpu_distalg.parallel import comms as pcomms
 from tpu_distalg.parallel import membership
 from tpu_distalg.parallel.ssp import (
     DEFAULT_DECAY,
@@ -88,6 +89,19 @@ POLL_SECONDS = 0.05
 DEFAULT_HEARTBEAT_TIMEOUT = 5.0
 #: coordinator-schedule cell code for a kill (hang cells hold seconds)
 COORD_KILL = -1.0
+
+PULL_SEED_TAG = pcomms.PULL_SEED_TAG
+
+#: every Nth commit version ships a DENSE version-pinned pull instead
+#: of a delta: pull-direction quantization noise has no EF channel
+#: (each decoded delta adds independent rounding noise to the
+#: worker's cached view — a random walk of stddev ~ sqrt(windows) ·
+#: scale), so the periodic refresh bounds the drift at
+#: sqrt(REFRESH) · scale instead of letting a long run's workers
+#: train against an ever-worse center. Amortized wire cost: 4d/16 =
+#: 0.25 bytes/elem/window on top of int8's ~1 — the reduction claim
+#: survives. A pure function of cv, so replays are unaffected.
+PULL_REFRESH_WINDOWS = 16
 
 FREE, ACTIVE, DEAD = "free", "active", "dead"
 
@@ -183,6 +197,13 @@ class ClusterConfig:
     checkpoint_every: int = 8               # windows between center saves
     policy: str = "elastic"                 # 'elastic' | 'restart'
     plan_spec: str | None = None            # fault plan handed to workers
+    #: cluster wire schedule — ``dense`` (f32 snapshots/deltas, the
+    #: pre-compression trajectories bit-for-bit), ``int8[:seed]``
+    #: (seeded stochastic rounding, ~1 byte/elem both directions) or
+    #: ``topk[:frac]`` ((value, index) pairs with worker-side error
+    #: feedback on pushes; pulls ride the int8 codec — see
+    #: ``worker.py``). ``@seq`` disables the async push overlap.
+    comm: str = "dense"
     train: TrainTask = dataclasses.field(default_factory=TrainTask)
 
     def __post_init__(self):
@@ -191,6 +212,9 @@ class ClusterConfig:
         if self.staleness < 1:
             raise ValueError(
                 f"staleness must be >= 1, got {self.staleness}")
+        # parse-validate eagerly: an unknown/deviceless schedule must
+        # fail at config time, not in a worker subprocess mid-join
+        pcomms.make_host_codec(self.comm)
         if self.policy not in ("elastic", "restart"):
             raise ValueError(
                 f"unknown policy {self.policy!r}: 'elastic' (continue "
@@ -254,9 +278,21 @@ class Coordinator:
     def __init__(self, config: ClusterConfig, *, die=None):
         self.cfg = config
         self.task = config.train
+        # the cluster wire codec (None = dense, the verbatim legacy
+        # path) + the model's known center layout for exact decode;
+        # compressed modes keep a bounded center-version history in
+        # the PS for version-delta pulls (deep enough that any base
+        # the SSP gate admits — plus the async push's one-window lag
+        # — still resolves to a delta instead of a dense fallback)
+        self._codec = pcomms.make_host_codec(config.comm)
+        self._pull_codec = pcomms.make_host_pull_codec(config.comm)
+        self._center_template = init_center(config.train)
+        self._history_depth = (0 if self._codec is None
+                               else 2 * config.staleness + 8)
         self.ps = psmod.ParameterServer(
             init_center(self.task), table=config.table,
-            n_shards=config.ps_shards, decay=config.decay)
+            n_shards=config.ps_shards, decay=config.decay,
+            history_depth=self._history_depth)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.slots = {i: SlotState() for i in range(config.n_slots)}
@@ -283,8 +319,11 @@ class Coordinator:
         self._stop = threading.Event()
         self._die_fn = die            # thread-mode override (sockets
         #                               slam instead of a real SIGKILL)
+        comm_sched = pcomms.CommSpec.parse(config.comm).schedule
         self._tag = (f"cluster:{self.task.algo}:ssp:"
-                     f"{config.staleness}:{config.decay:g}")
+                     f"{config.staleness}:{config.decay:g}"
+                     + ("" if comm_sched == "dense"
+                        else f":{comm_sched}"))
         self.port: int | None = None
         self.wal: walmod.WriteAheadLog | None = None
         plan = (fregistry.FaultPlan.parse(config.plan_spec)
@@ -293,6 +332,9 @@ class Coordinator:
             config.n_windows, plan=plan)
         self._coord_fired: set[int] = set()
         self._maybe_resume()
+        # seed the version history at whatever center recovery landed
+        # on (replayed commits already recorded theirs inside merge)
+        self.ps.record_history(self.version)
 
     # ------------------------------------------------------ lifecycle
 
@@ -325,9 +367,15 @@ class Coordinator:
                       for k, v in payload["center"].items()}
             self.ps = psmod.ParameterServer(
                 center, table=self.cfg.table,
-                n_shards=self.cfg.ps_shards, decay=self.cfg.decay)
+                n_shards=self.cfg.ps_shards, decay=self.cfg.decay,
+                history_depth=self._history_depth)
             self.version = int(step)
             self.ps.version = self.version
+            # the restored base enters the version history BEFORE the
+            # WAL replay merges on top: a re-pushed window whose
+            # original ack diffed against this base must re-serve the
+            # identical delta bytes, not a dense fallback
+            self.ps.record_history(self.version)
         if self.cfg.policy == "restart":
             # the gang-scheduled BASELINE deliberately has no WAL:
             # it restarts from the last PERIODIC save and re-pays
@@ -506,7 +554,8 @@ class Coordinator:
             prefix = f"{slot}/"
             delta = {k[len(prefix):]: v for k, v in arrays.items()
                      if k.startswith(prefix)}
-            contribs.append((slot, int(c["base"]), delta))
+            contribs.append((slot, int(c["base"]),
+                             self._decode_delta(delta)))
         skipped = [int(s) for s in meta.get("skipped", ())]
         for s in skipped:
             st = self.slots.get(s)
@@ -868,6 +917,7 @@ class Coordinator:
             "heartbeat_interval": self.cfg.heartbeat_interval,
             "heartbeat_timeout": self.cfg.heartbeat_timeout,
             "rpc_deadline": self.cfg.rpc_deadline,
+            "comm": self.cfg.comm,
             "plan": self.cfg.plan_spec,
             "train": self.task.as_meta(),
             "done": self.done,
@@ -988,6 +1038,68 @@ class Coordinator:
             self._try_commit()
             return ("ok", self._status_meta(), {})
 
+    def _decode_delta(self, arrays: dict) -> dict:
+        """A pushed contribution's dense reconstruction: identity in
+        dense mode; under a wire codec the exact host decode (int8 ->
+        int32 widening before the one scale multiply, topk scatter-
+        add) against the model's known center layout. The WAL and the
+        idempotence digests see the COMPRESSED bytes — this decode is
+        a pure function of them, so replay stays bitwise."""
+        if self._codec is None:
+            return arrays
+        return pcomms.decode_tree(self._codec, arrays,
+                                  self._center_template)
+
+    def _pull_reply(self, slot: int, window: int, have) -> tuple:
+        """Lock held. The deferred push-ack's pull payload for a push
+        of ``window`` from ``slot``. Dense mode ships the live center
+        snapshot (the pre-compression contract, bit-for-bit). Under a
+        wire codec the reply is VERSION-PINNED to the push's own
+        commit (``cv = window + 1``) and ships the compressed delta
+        ``center@cv − center@have`` (seeded by (slot, have, cv), so a
+        recovered coordinator re-serves identical bytes); a ``have``
+        outside the PS history falls back to a dense version-pinned
+        snapshot — the resume/rejoin path — and every
+        :data:`PULL_REFRESH_WINDOWS`-th commit ships dense ON
+        SCHEDULE, bounding the pull-noise random walk in the worker's
+        cached view."""
+        if self._codec is None:
+            return ("center", self._status_meta(), self.ps.snapshot())
+        cv = window + 1
+        if have is not None and int(have) < cv \
+                and cv % PULL_REFRESH_WINDOWS:
+            delta = self.ps.delta_since(int(have), cv)
+            if delta is not None:
+                arrays, _ = pcomms.encode_tree(
+                    self._pull_codec, delta, None,
+                    PULL_SEED_TAG, slot, int(have), cv)
+                meta = self._status_meta()
+                meta.update(mode="delta", cv=cv, have=int(have))
+                tevents.counter("cluster.delta_pulls")
+                return ("center", meta, arrays)
+        meta = self._status_meta()
+        meta["mode"] = "dense"
+        # pin the fallback to the OLDEST history version >= cv, never
+        # the live clock: a peer's concurrent commit (a WAL-replayed
+        # skip can release a window this slot never re-delivers) may
+        # already have advanced self.version, and an arrival-timed cv
+        # would make the worker's next push base — and so the
+        # decay^age merge weights — scheduler-dependent, breaking the
+        # plan-determined replay contract exactly on the recovery
+        # path it exists for
+        newer = sorted(v for v in self.ps.history if v >= cv)
+        if newer:
+            meta["cv"] = newer[0]
+            snap = self.ps.history[newer[0]]
+        else:   # no history at all (dense-depth 0 cannot reach here)
+            meta["cv"] = self.version
+            snap = self.ps.snapshot()
+        if not cv % PULL_REFRESH_WINDOWS:
+            tevents.counter("cluster.pull_refreshes")
+        else:
+            tevents.counter("cluster.pull_dense_fallbacks")
+        return ("center", meta, snap)
+
     def _handle_push(self, meta, arrays) -> tuple:
         window = int(meta["window"])
         base = int(meta["base"])
@@ -1001,7 +1113,7 @@ class Coordinator:
                 # before the deferred ack left, so the worker pushed
                 # again after reconnecting. Idempotent by the WAL's
                 # commit digest: the same bytes were already merged —
-                # ack with the current center, apply nothing.
+                # ack with the window's own pull reply, apply nothing.
                 want = self.commit_digests.get(
                     (window, int(meta["slot"])))
                 if want is not None and \
@@ -1012,8 +1124,8 @@ class Coordinator:
                                  f"mismatch vs the committed record "
                                  f"— refusing to double-apply"}, {})
                 tevents.counter("cluster.dedup_pushes")
-                return ("center", self._status_meta(),
-                        self.ps.snapshot())
+                return self._pull_reply(int(meta["slot"]), window,
+                                        meta.get("have"))
             st.pushes[window] = (base, dict(arrays))
             st.delivered = max(st.delivered, window)
             # (no cluster.pushes bump: the worker owns it — see skip)
@@ -1030,7 +1142,8 @@ class Coordinator:
             if self._fenced(meta) is not st:
                 return ("error", {"error": "declared dead while "
                                            "awaiting commit"}, {})
-            return ("center", self._status_meta(), self.ps.snapshot())
+            return self._pull_reply(int(meta["slot"]), window,
+                                    meta.get("have"))
 
     def _handle_bye(self, meta) -> tuple:
         slot = int(meta["slot"])
@@ -1189,7 +1302,12 @@ class Coordinator:
                  for k, v in d.items()})
             for c in wal_meta["contribs"]:
                 self.commit_digests[(w, c["slot"])] = c["digest"]
-            records = self.ps.merge(w, contribs)
+            # the WAL carried the COMPRESSED payload bytes (the redo
+            # log replays bitwise); the exact host decode happens
+            # here, strictly after durability, in slot order
+            records = self.ps.merge(
+                w, [(i, b, self._decode_delta(d))
+                    for i, b, d in contribs])
             self.version = w + 1
             if self.recovered and self.first_recommit_at is None:
                 self.first_recommit_at = time.monotonic()

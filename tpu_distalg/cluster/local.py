@@ -467,6 +467,7 @@ class _ProcCoordinator:
                str(config.heartbeat_interval),
                "--rpc-deadline", str(config.rpc_deadline),
                "--reconnect-grace", str(config.reconnect_grace),
+               "--comm", config.comm,
                # the EXACT TrainTask, every field — workers take the
                # task from the coordinator's welcome, so a lossy
                # handoff here would silently train a different task

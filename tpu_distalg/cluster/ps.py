@@ -112,10 +112,20 @@ class PsShard:
 class ParameterServer:
     """The tier: ``n_shards`` :class:`PsShard`\\ s over one model's
     center, plus the version counter (= windows merged so far — the
-    number a contribution's age is measured against)."""
+    number a contribution's age is measured against).
+
+    ``history_depth > 0`` keeps a bounded ``{version: center}`` ring
+    of post-merge snapshots — the compressed cluster wire's
+    VERSION-DELTA pull source: a worker caching center@v is served
+    ``quantize(center@new − center@v)`` instead of a dense snapshot,
+    and because the ring rebuilds deterministically from WAL replay
+    (each replayed commit re-records its snapshot), a recovered
+    coordinator re-serves bit-identical pull bytes. Dense mode keeps
+    the depth at 0: zero overhead, trajectories pinned to history."""
 
     def __init__(self, center: dict, *, table: str = "lr",
-                 n_shards: int = 2, decay: float = DEFAULT_DECAY):
+                 n_shards: int = 2, decay: float = DEFAULT_DECAY,
+                 history_depth: int = 0):
         self.table = table
         self.decay = float(decay)
         self.n_shards = int(n_shards)
@@ -123,6 +133,8 @@ class ParameterServer:
                        split_center(center, table, self.n_shards)]
         self._version_lock = threading.Lock()
         self.version = 0  # windows merged into the center
+        self.history_depth = int(history_depth)
+        self.history: dict[int, dict] = {}
 
     @staticmethod
     def weight(decay: float, age: int) -> float:
@@ -156,7 +168,32 @@ class ParameterServer:
                 [(w, pieces[i]) for w, pieces in weighted])
         with self._version_lock:
             self.version = max(self.version, commit_window + 1)
+        self.record_history(commit_window + 1)
         return records
+
+    # --------------------------------------------- version history
+
+    def record_history(self, version: int) -> None:
+        """Snapshot the center as ``center@version`` into the bounded
+        ring (no-op at depth 0); pruned oldest-first."""
+        if self.history_depth <= 0:
+            return
+        self.history[int(version)] = self.snapshot()
+        while len(self.history) > self.history_depth:
+            del self.history[min(self.history)]
+
+    def delta_since(self, have: int, version: int) -> dict | None:
+        """``{name: center@version − center@have}`` leafwise, or
+        ``None`` when either endpoint fell out of the ring (the
+        caller falls back to a dense snapshot — the resume/rejoin
+        path)."""
+        a = self.history.get(int(have))
+        b = self.history.get(int(version))
+        if a is None or b is None:
+            return None
+        return {name: (np.asarray(b[name], np.float32)
+                       - np.asarray(a[name], np.float32))
+                for name in b}
 
     def snapshot(self) -> dict:
         """The assembled center (copies, consistent per shard)."""

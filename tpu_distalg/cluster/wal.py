@@ -7,9 +7,12 @@ stranded every worker and discarded all progress since the last
 periodic center save. This module closes that hole: every state
 transition the replay contract depends on — admissions and incarnation
 grants, announced skips, window commits (slot-ordered contribution
-digests *and* the applied delta bytes — a redo log), membership
-epochs, admission holds — is appended as a CRC-framed record and
-fsynced *before* the corresponding ack leaves the socket. On restart
+digests *and* the applied delta bytes — a redo log; under a ``--comm``
+wire codec these are the COMPRESSED payload bytes exactly as pushed,
+so replay re-runs the same exact decode and the re-push dedup digests
+match a resent frame by construction), membership epochs, admission
+holds — is appended as a CRC-framed record and fsynced *before* the
+corresponding ack leaves the socket. On restart
 the coordinator replays the ledger on top of the newest durable center
 checkpoint and resumes as if it never died; a half-committed window
 (pushes that arrived but never committed) is simply absent from the

@@ -36,6 +36,22 @@ send failures: a broken heartbeat connection is re-dialed with a
 short bounded retry (``cluster.heartbeat_retries``) instead of
 leaving the socket dead while the main loop lives.
 
+COMPRESSED WIRE (``--comm int8[:seed]``/``topk[:frac]``, from the
+welcome frame): the window delta is host-encoded BEFORE transport
+framing (``parallel/comms.py`` codecs — seeded per (slot, window), so
+replays are bitwise), with the error-feedback residual carried in
+THIS loop's state: what the wire did not carry rides into the next
+window's encode, and a fresh re-admission (reset) zeroes it with the
+rest of the local state. Pulls are version deltas: the worker caches
+``center@have`` and the deferred ack ships only the compressed diff
+to the push's own commit version (dense snapshot on rejoin/deep
+recovery). Unless ``@seq``, the push runs ASYNCHRONOUSLY on a
+background sender over a second crash-tolerant :class:`_Link`, so
+the next window's ticks start immediately; the next boundary
+harvests the ack and REBASES the local weights onto the fresher
+center (stale-model SSP — the gate's ``window − version ≤ s`` bound
+is unchanged, because the version still only advances at commit).
+
 RECONNECT (coordinator crash tolerance): ``TransportClosed``/
 ``TransportTimeout`` on the control connection no longer kills the
 worker. :class:`_Link` wraps every control-plane round trip in a
@@ -65,6 +81,7 @@ import numpy as np
 
 from tpu_distalg.cluster import transport
 from tpu_distalg.faults import registry as fregistry
+from tpu_distalg.parallel import comms as pcomms
 from tpu_distalg.parallel import ssp as pssp
 from tpu_distalg.telemetry import events as tevents
 from tpu_distalg.telemetry import heartbeat as theartbeat
@@ -88,6 +105,13 @@ RECONNECT_BACKOFF_CAP_SECONDS = 1.0
 RECONNECT_JITTER = 0.25
 
 
+class LinkClosed(RuntimeError):
+    """The link was closed on purpose (worker shutdown / kill cell):
+    NOT a transport fault, so the retry loop never re-dials — a
+    background pusher outliving a thread-mode kill must not
+    resume-join and resurrect the slot."""
+
+
 class _Link:
     """The worker's control connection with crash-tolerant round
     trips: every request retries through re-dial + resume-join on a
@@ -107,6 +131,7 @@ class _Link:
         self.rpc_deadline = rpc_deadline
         self.stats = stats
         self.log = log
+        self.closed = False
         self._pending_reset = None
 
     def drop(self):
@@ -116,6 +141,10 @@ class _Link:
             except OSError:
                 pass
             self.sock = None
+
+    def close(self):
+        self.closed = True
+        self.drop()
 
     def _resume(self, *, dial_attempts: int = 200,
                 resume_only: bool = False):
@@ -180,6 +209,9 @@ class _Link:
         best_effort = retries < RECONNECT_RETRIES
 
         def attempt():
+            if self.closed:
+                raise LinkClosed("link closed — no further round "
+                                 "trips (worker shutting down)")
             if self.sock is None:
                 self._resume(
                     dial_attempts=20 if best_effort else 200,
@@ -265,6 +297,68 @@ class _HbLink:
     def close(self):
         with self.lock:
             self._drop()
+
+
+class _DonePush:
+    """An already-completed push round trip wearing the
+    :class:`_PendingPush` interface, so the synchronous (``@seq`` /
+    dense) path folds its ack through the SAME ``harvest`` code as
+    the overlapped one — one implementation of the deferred-ack
+    contract, no drift between the two spellings."""
+
+    def __init__(self, window: int, base: int, result, rtt_ms: float):
+        self.window = window
+        self.base = base
+        self.rtt_ms = rtt_ms
+        self._result = result
+
+    def wait(self):
+        return self._result
+
+
+class _PendingPush:
+    """One in-flight push: the full crash-tolerant round trip (send →
+    deferred commit → pull reply) runs on a background thread over a
+    DEDICATED link, so the next window's ticks start immediately —
+    the push/pull overlap. ``rtt_ms`` is measured inside the thread
+    (send to reply), so the reported push→commit→pull latency never
+    absorbs the overlapped compute. At most one is in flight: the
+    next boundary harvests it before sending again, which keeps the
+    SSP gate's bound the only staleness authority."""
+
+    def __init__(self, link: _Link, window: int, base: int,
+                 meta: dict, arrays: dict, deadline: float):
+        self.window = window
+        self.base = base
+        self.rtt_ms = 0.0
+        self._lock = threading.Lock()
+        self._result = None
+        self._error: BaseException | None = None
+
+        def _send():
+            t0 = time.monotonic()
+            try:
+                reply = link.request("push", meta, arrays,
+                                     deadline=deadline)
+                with self._lock:
+                    self._result = reply
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                with self._lock:
+                    self._error = e
+            finally:
+                with self._lock:
+                    self.rtt_ms = (time.monotonic() - t0) * 1e3
+
+        self._t = threading.Thread(
+            target=_send, name="tda-cluster-push", daemon=True)
+        self._t.start()
+
+    def wait(self):
+        self._t.join()
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return self._result
 
 
 class WorkerKilled(Exception):
@@ -526,9 +620,24 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
              "push_pull_ms_total": 0.0, "push_pull_ms": [],
              "ages": [], "windows": 0, "undelivered_windows": 0,
              "reconnects": 0, "readmissions": 0,
-             "heartbeat_retries": 0}
+             "heartbeat_retries": 0, "delta_pulls": 0,
+             "dense_pulls": 0, "async_pushes": 0}
     link = _Link(host, port, sock, connect, ident, rpc_deadline,
                  stats, log)
+
+    # the cluster wire schedule (the coordinator's welcome carries the
+    # one spelling every process runs under): dense keeps the verbatim
+    # f32 snapshot protocol; int8/topk compress the push delta (EF
+    # residual carried HERE, in the loop state) and receive
+    # version-delta pulls against the cached center view. @seq forces
+    # the synchronous push; otherwise compressed pushes overlap the
+    # next window's compute on a background sender
+    comm_spec = pcomms.CommSpec.parse(meta.get("comm") or "dense")
+    codec = pcomms.make_host_codec(comm_spec)
+    pull_codec = pcomms.make_host_pull_codec(comm_spec)
+    overlap_push = codec is not None and comm_spec.overlap
+    push_link = (_Link(host, port, None, connect, ident, rpc_deadline,
+                       stats, log) if overlap_push else None)
 
     # liveness: the shared Heartbeat thread, its emit_fn ALSO framing a
     # beat to the coordinator over its own crash-tolerant link —
@@ -548,29 +657,108 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
 
     pending_windows = 0   # trained-but-not-yet-pushed (busy skips)
     version = int(meta["version"])
-    w_base = np.asarray(center["w"], np.float32)
+    w_base = np.asarray(center["w"], np.float32)   # cached center view
     w_local = w_base.copy()
-    base = version
+    cut = w_local            # progress in (cut -> w_local) is unpushed
+    base = version           # version underlying w_local's training
+    have = version           # version of the cached center view
+    residual = (pcomms.zero_residuals({"w": w_base})
+                if codec is not None else None)
     window = int(meta["admit"])
     done = bool(meta.get("done"))
     restart = False
     killed = False
+    pending: _PendingPush | None = None   # the one in-flight push
 
     def adopt_reset(m, arrays):
         """A fresh re-admission (the old incarnation was declared
         dead during a coordinator outage): adopt the welcome like a
         brand-new join — new admission window, the current center,
-        zero pending work."""
+        zero pending work, a zero EF residual."""
         nonlocal version, done, restart, window, w_base, w_local, \
-            base, pending_windows
+            base, have, cut, residual, pending_windows, pending, \
+            push_link
+        # an in-flight push predates the reset: its reply (if any) is
+        # for a dead incarnation — abandoned, never harvested. Its
+        # sender thread may still hold the push link mid-retry, so
+        # the link is CLOSED (the thread exits on LinkClosed instead
+        # of re-dialing) and a fresh one minted: the re-admitted
+        # incarnation's next push must never interleave frames with
+        # the zombie on one socket
+        pending = None
+        if push_link is not None:
+            push_link.close()
+            push_link = _Link(push_link.host, push_link.port, None,
+                              push_link.connect, ident, rpc_deadline,
+                              stats, log)
         version = int(m["version"])
         done = bool(m.get("done"))
         restart = bool(m.get("restart"))
         window = int(m["admit"])
         w_base = np.asarray(arrays["w"], np.float32)
         w_local = w_base.copy()
+        cut = w_local
         base = version
+        have = version
+        if codec is not None:
+            residual = pcomms.zero_residuals({"w": w_base})
         pending_windows = 0
+
+    def adopt_pull(m, arrays):
+        """Fold one pull payload into the cached center view: a
+        ``delta`` reply applies the compressed ``center@cv −
+        center@have`` diff to the view (the worker-side half of the
+        version-delta protocol — both ends decode the same bytes), a
+        ``dense`` reply (resume/rejoin fallback, and the whole dense
+        schedule) replaces it. ``base`` pins to the reply's center
+        version — under a codec that is the push's own commit
+        (``cv``), a pure function of the plan, never the live clock a
+        concurrently-committing peer may already have advanced."""
+        nonlocal w_base, have, base
+        mode = m.get("mode")
+        if mode == "delta":
+            delta = pcomms.decode_tree(pull_codec, arrays,
+                                       {"w": w_base})["w"]
+            w_base = w_base + delta
+            have = int(m["cv"])
+            stats["delta_pulls"] += 1
+        elif mode == "dense":
+            w_base = np.asarray(arrays["w"], np.float32)
+            have = int(m["cv"])
+            stats["dense_pulls"] += 1
+        else:   # legacy dense reply (no codec): live center + version
+            w_base = np.asarray(arrays["w"], np.float32)
+            have = int(m.get("version", have))
+        base = have
+
+    def harvest(p: _PendingPush, transplant):
+        """Fold an in-flight push's deferred ack into the loop state:
+        record the round trip, refresh the cached view, and REBASE
+        the local weights onto the fresher center — transplanting
+        ``transplant`` (the progress trained while the push was in
+        flight; ``None`` = the synchronous path, nothing trained
+        since). Returns ``False`` on a reset (the caller restarts its
+        iteration)."""
+        nonlocal version, done, restart, w_local
+        k, m, arrs = p.wait()
+        if k == "reset":
+            adopt_reset(m, arrs)
+            return False
+        version = int(m.get("version", version))
+        done = bool(m.get("done", done))
+        restart = bool(m.get("restart", restart))
+        if k == "error":
+            raise transport.TransportClosed(
+                f"push rejected: {m.get('error')}")
+        stats["pushes"] += 1
+        stats["push_pull_ms"].append(round(p.rtt_ms, 3))
+        stats["push_pull_ms_total"] += p.rtt_ms
+        stats["ages"].append(max(0, p.window - p.base))
+        tevents.counter("cluster.pushes")
+        adopt_pull(m, arrs)
+        w_local = (w_base + transplant if transplant is not None
+                   else w_base.copy())
+        return True
 
     def rpc(kind, meta_, arrays=None, deadline=None):
         """One crash-tolerant round trip; folds a ``reset`` into the
@@ -604,11 +792,14 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             if not done and not restart:
                 k, m, arrays = rpc("pull", dict(ident))
                 if k != "reset":
-                    w_base = np.asarray(arrays["w"], np.float32)
+                    adopt_pull(m, arrays)
                     w_local = w_base.copy()
-                    base = version
+                    cut = w_local
         while window < n_windows and not done and not restart:
-            # the SSP gate: never more than s windows past the clock
+            # the SSP gate: never more than s windows past the clock —
+            # UNCHANGED under the push/pull overlap (an async push for
+            # window w−1 still counts against the same bound: the
+            # version only advances when that window commits)
             t_gate = time.monotonic()
             while window - version > s:
                 if time.monotonic() - t_gate > GATE_DEADLINE_SECONDS:
@@ -630,12 +821,17 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
             if cell == KILL:
                 # kill -9 MID-WINDOW: half the ticks land, the push
                 # never happens, the sockets slam shut (EOF is the
-                # coordinator's fastest death signal)
+                # coordinator's fastest death signal). A pusher link
+                # closes FIRST: its background retry loop must not
+                # resume-join and resurrect the dead incarnation in
+                # thread mode
                 w_local = trainer.run(w_local, window,
                                       max(1, s // 2))
                 tevents.emit("cluster_worker_kill", slot=slot,
                              window=window)
                 killed = True
+                if push_link is not None:
+                    push_link.close()
                 die()
                 return stats          # thread-mode die() returns
             busy = cell > 0
@@ -654,37 +850,66 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                 pending_windows += 1
                 window += 1
                 continue
-            delta = w_local - w_base
-            t0 = time.monotonic()
+            # -- push boundary -----------------------------------
+            # cut the un-pushed progress (this window's training,
+            # plus any busy windows' riding along), harvest the
+            # previous in-flight ack — the overlap: that ack's
+            # commit ran UNDER this window's compute — rebase onto
+            # the fresher center, then send
+            progress = w_local - cut
+            push_base = base       # version this progress trained on
+            if pending is not None:
+                p, pending = pending, None
+                if not harvest(p, progress):
+                    continue       # reset adopted: restart the loop
+            if codec is None:
+                arrays_out = {"w": progress}
+                push_meta = dict(ident, window=window,
+                                 base=push_base)
+            else:
+                # EF: compress (progress + residual), carry the rest
+                arrays_out, residual = pcomms.encode_tree(
+                    codec, {"w": progress}, residual,
+                    pcomms.PUSH_SEED_TAG, slot, window)
+                push_meta = dict(ident, window=window,
+                                 base=push_base, have=have)
             # the ack is DEFERRED until this window commits — which
             # can legitimately wait out an admission hold (a respawned
             # PROCESS worker pays spawn + jax import + first compile),
             # so the recv deadline is the gate's, not the rpc's
-            k2, m, arrays = rpc(
-                "push",
-                dict(ident, window=window, base=base),
-                {"w": delta},
-                deadline=max(rpc_deadline, GATE_DEADLINE_SECONDS))
-            rtt = (time.monotonic() - t0) * 1e3
-            if k2 == "reset":
-                continue
-            if k2 == "error":
-                raise transport.TransportClosed(
-                    f"push rejected: {m.get('error')}")
-            stats["pushes"] += 1
-            stats["push_pull_ms"].append(round(rtt, 3))
-            stats["push_pull_ms_total"] += rtt
-            stats["ages"].append(max(0, window - base))
-            tevents.counter("cluster.pushes")
-            # adopt the post-commit center: fresh base, zero delta
-            w_base = np.asarray(arrays["w"], np.float32)
-            w_local = w_base.copy()
-            base = version
+            push_deadline = max(rpc_deadline, GATE_DEADLINE_SECONDS)
+            if overlap_push:
+                pending = _PendingPush(push_link, window, push_base,
+                                       push_meta, arrays_out,
+                                       push_deadline)
+                stats["async_pushes"] += 1
+                tevents.counter("cluster.async_pushes")
+                cut = w_local
+            else:
+                t0 = time.monotonic()
+                reply = link.request("push", push_meta, arrays_out,
+                                     deadline=push_deadline)
+                p = _DonePush(window, push_base, reply,
+                              (time.monotonic() - t0) * 1e3)
+                if not harvest(p, None):
+                    continue
+                cut = w_local
             pending_windows = 0
             window += 1
     finally:
+        if pending is not None:
+            # drain the final in-flight ack (its commit is the run's
+            # last window; losing it would drop the round trip from
+            # the stats and leave the handler blocked on our socket)
+            try:
+                harvest(pending, None)
+            except (transport.TransportError, LinkClosed):
+                pass
+            pending = None
         hb.stop()
         hb_link.close()
+        if push_link is not None:
+            push_link.close()
         if not killed:
             if pending_windows:
                 # a straggle cell on the FINAL window(s) leaves

@@ -170,14 +170,16 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
 
 def _make_runner(workload: str, mesh, n_iterations: int | None,
                  checkpoint_every: int | None, workdir: str,
-                 spawn: str = "thread"):
+                 spawn: str = "thread", comm: str = "dense"):
     """Build ``run(checkpoint_dir) -> result`` for one workload, small
     defaults. ``checkpoint_dir=None`` runs unsegmented (kmeans_stream —
     stateless, restart-from-scratch recovery). ``workdir`` hosts any
     on-disk artifact the workload needs beyond checkpoints (the
-    streamed graph cache). ``spawn`` applies to the cluster workload
-    only (thread-mode workers for the fast smoke, real processes for
-    the genuine kill -9)."""
+    streamed graph cache). ``spawn`` and ``comm`` apply to the cluster
+    workload only (thread-mode workers for the fast smoke, real
+    processes for the genuine kill -9; ``comm`` is the wire schedule
+    BOTH runs use — compression must compose with chaos, same
+    verdict)."""
     if workload == "cluster":
         from tpu_distalg import cluster as clus
         from tpu_distalg.cluster.local import event_digest
@@ -198,6 +200,7 @@ def _make_runner(workload: str, mesh, n_iterations: int | None,
                 # verdict for the wrong reason
                 heartbeat_timeout=15.0, checkpoint_every=every,
                 checkpoint_dir=ckpt_dir, plan_spec=plan_spec,
+                comm=comm,
                 train=clus.TrainTask(n_rows=1024, test_rows=512))
             res = clus.run_local_cluster(cfg, spawn=spawn,
                                          timeout=280.0)
@@ -362,7 +365,7 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
               n_iterations: int | None = None,
               checkpoint_every: int | None = None,
               max_restarts: int = DEFAULT_MAX_RESTARTS,
-              spawn: str = "thread",
+              spawn: str = "thread", comm: str = "dense",
               logger=None) -> ChaosResult:
     """The harness core: undisturbed run, chaos run, bitwise compare.
 
@@ -384,7 +387,7 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     # shared artifact or consume its own hit counters out of schedule
     faults.configure(False)
     runner = _make_runner(workload, mesh, n_iterations, checkpoint_every,
-                          workdir, spawn=spawn)
+                          workdir, spawn=spawn, comm=comm)
     # kmeans_stream recovers by deterministic re-run, serve by
     # shed-and-client-retry — neither consumes a checkpoint dir
     uses_ckpt = workload not in ("kmeans_stream", "serve")

@@ -97,7 +97,12 @@ import math
 
 import numpy as np
 
-from tpu_distalg.parallel.mesh import DATA_AXIS
+#: mirror of ``parallel.mesh.DATA_AXIS`` — deliberately NOT imported:
+#: mesh.py imports jax at module level, and the cluster tier's
+#: jax-free host processes (coordinator, transport-only tools) import
+#: this module for the HOST-SIDE CODECS below; the device schedules
+#: keep importing jax lazily inside their functions as before
+DATA_AXIS = "data"
 
 SCHEDULES = ("dense", "bucketed", "hier", "bf16", "int8", "topk")
 
@@ -894,6 +899,224 @@ def emit_rank_combine_counters(k: int, length: int, n: int, *,
                     st["bytes_dense_ring"] * n_syncs)
     tevents.counter("graph.combine_syncs", n_syncs)
     return st
+
+
+# --------------------------------------------------------------------
+# Host-side wire codecs — the cluster tier's spelling of the schedules.
+#
+# The device schedules above compress SPMD collectives; the
+# multi-process cluster (tpu_distalg/cluster/) moves the same payloads
+# over a real TCP wire, host-to-host, where the quantize/dequantize +
+# error-feedback stages run in numpy BEFORE transport framing. These
+# codecs are that reusable stage: pure functions of (spec.seed, the
+# caller's integer path) — the host-side counterpart of the device
+# threefry fold-in chain — so every process reconstructs identical
+# bytes and a chaos replay stays bitwise. numpy + stdlib only: the
+# coordinator process never imports jax.
+#
+#   int8  seeded stochastic rounding against a shared max-abs scale;
+#         the decoder widens int8 -> int32 EXACTLY before the single
+#         f32 scale multiply (the wire itself carries 1 byte/elem —
+#         TDA051 polices the opposite order).
+#   topk  the k largest-|.| entries as (value, index) pairs — 8k pair
+#         bytes on the wire; the decoder scatter-adds them exactly.
+#
+# Both run under ERROR FEEDBACK when the caller carries a residual:
+# ``encode(vec)`` compresses ``vec + residual`` and returns the new
+# residual (what the wire did not carry), so nothing is ever lost —
+# the EF-SGD correction of the device topk schedule, applied uniformly
+# (stochastic int8 is unbiased already; EF additionally bounds its
+# worst case). The residual is the caller's to checkpoint/resume.
+
+
+#: seed-path direction tags — a cluster push folds in
+#: ``(PUSH_SEED_TAG, slot, window)``, a pull ``(PULL_SEED_TAG, slot,
+#: have, version)``: the two directions can never share a rounding
+#: stream
+PUSH_SEED_TAG = 1
+PULL_SEED_TAG = 2
+
+
+def host_rng(seed: int, *path: int) -> np.random.Generator:
+    """Counter-based generator keyed by ``(seed, path...)`` — the
+    host-side stand-in for ``jax.random.fold_in`` chains (Philox under
+    a SeedSequence; both are spec-fixed, so the stream is stable
+    across platforms and numpy versions)."""
+    ss = np.random.SeedSequence(
+        entropy=int(seed) & 0xFFFFFFFFFFFFFFFF,
+        spawn_key=tuple(int(p) & 0xFFFFFFFF for p in path))
+    return np.random.Generator(np.random.Philox(ss))
+
+
+class HostCodec:
+    """Base: a stateless vector codec; EF residual rides the caller."""
+
+    #: frames-on-the-wire name (welcome meta / telemetry)
+    name = "?"
+
+    def __init__(self, spec: "CommSpec"):
+        self.spec = spec
+
+    def encode(self, vec: np.ndarray, residual: np.ndarray | None,
+               *path: int):
+        """``(arrays, residual_new)`` for one f32 vector. ``path`` is
+        the deterministic seed path — (direction, slot, window) for a
+        worker push, (direction, slot, have, version) for a pull."""
+        raise NotImplementedError
+
+    def decode(self, arrays: dict, length: int) -> np.ndarray:
+        """The dense f32 ``(length,)`` reconstruction — exact integer
+        widening / scatter-add, deterministic on every host."""
+        raise NotImplementedError
+
+
+class Int8HostCodec(HostCodec):
+    """Seeded stochastic rounding to int8 against a max-abs scale."""
+
+    name = "int8"
+
+    def encode(self, vec, residual, *path):
+        x = np.asarray(vec, np.float32)
+        if residual is not None:
+            x = x + residual
+        scale = np.float32(max(float(np.max(np.abs(x)))
+                               if x.size else 0.0, 1e-30) / 127.0)
+        u = host_rng(self.spec.seed, *path).random(
+            x.shape, dtype=np.float32)
+        q = np.clip(np.floor(x / scale + u), -127, 127).astype(np.int8)
+        # shape (1,): the transport frames scalars at min-ndim 1
+        arrays = {"q": q, "scale": np.full((1,), scale, np.float32)}
+        res_new = (x - q.astype(np.float32) * scale
+                   if residual is not None else None)
+        return arrays, res_new
+
+    def decode(self, arrays, length):
+        q = np.asarray(arrays["q"])
+        # EXACT widening strictly after the wire (TDA051's contract),
+        # then the one f32 scale multiply
+        wide = q.astype(np.int32)
+        return (wide.astype(np.float32)
+                * np.float32(arrays["scale"])).reshape(length)
+
+
+class TopkHostCodec(HostCodec):
+    """The k largest-|.| entries as (value, index) pairs."""
+
+    name = "topk"
+
+    def k_for(self, length: int) -> int:
+        return max(1, int(round(self.spec.topk_fraction
+                                * max(1, length))))
+
+    def encode(self, vec, residual, *path):
+        x = np.asarray(vec, np.float32)
+        if residual is not None:
+            x = x + residual
+        k = self.k_for(x.size)
+        # stable sort => deterministic tie-breaks on every host
+        idx = np.argsort(-np.abs(x), kind="stable")[:k].astype(np.int32)
+        vals = x[idx]
+        arrays = {"vals": vals, "idx": idx}
+        if residual is None:
+            return arrays, None
+        res_new = x.copy()
+        res_new[idx] = 0.0
+        return arrays, res_new
+
+    def decode(self, arrays, length):
+        out = np.zeros((length,), np.float32)
+        # exact scatter-add (duplicate indices accumulate additively)
+        np.add.at(out, np.asarray(arrays["idx"], np.int64),
+                  np.asarray(arrays["vals"], np.float32))
+        return out
+
+
+#: schedules the cluster wire admits (the device-only schedules —
+#: bucketed/hier/bf16 — have no host spelling worth framing: bf16
+#: halves bytes where int8 quarters them, bucketing is a collective-
+#: overlap concern, and hier is a topology concern)
+HOST_SCHEDULES = ("dense", "int8", "topk")
+
+
+def make_host_codec(spec) -> HostCodec | None:
+    """The host codec for a :class:`CommSpec` (or its CLI string) —
+    ``None`` for ``dense`` (callers keep their uncompressed path
+    verbatim, which is what pins dense bitwise to history)."""
+    spec = CommSpec.parse(spec)
+    if spec.schedule not in HOST_SCHEDULES:
+        raise ValueError(
+            f"comm schedule {spec.schedule!r} has no host-wire "
+            f"codec; the cluster tier takes one of "
+            f"{', '.join(HOST_SCHEDULES)}")
+    if spec.schedule == "int8":
+        return Int8HostCodec(spec)
+    if spec.schedule == "topk":
+        return TopkHostCodec(spec)
+    return None
+
+
+def make_host_pull_codec(spec) -> HostCodec | None:
+    """The PULL-direction codec: int8 under EVERY compressed mode
+    (``None`` for dense). The push direction can afford topk's biased
+    truncation because the worker-side EF residual re-sends dropped
+    mass later; the pull direction has no residual channel — pair
+    pulls would silently lose the untransmitted (1−frac) of every
+    center delta from the worker's cached view forever, or require
+    durable per-worker residual state at the coordinator that every
+    ack would have to WAL before leaving. int8's stochastic rounding
+    is unbiased and stateless, so a recovered coordinator re-serves
+    bit-identical pulls from the replayed center history alone. Both
+    ends derive this codec from the same spec, so they can never
+    disagree on the wire format."""
+    spec = CommSpec.parse(spec)
+    return (None if make_host_codec(spec) is None
+            else Int8HostCodec(spec))
+
+
+def encode_tree(codec: HostCodec, tree: dict,
+                residuals: dict | None, *path: int):
+    """Per-leaf host encode of a flat ``{name: ndarray}`` tree (the
+    cluster center/delta vocabulary): each float leaf flattens, rides
+    the codec under seed path ``(*path, leaf_index)``, and lands as
+    ``{name}.{part}`` wire arrays. Returns ``(arrays,
+    residuals_new)``; ``residuals`` maps name -> flat f32 residual
+    (or ``None`` for EF-free encoding)."""
+    arrays: dict = {}
+    res_new: dict | None = None if residuals is None else {}
+    for i, name in enumerate(sorted(tree)):
+        leaf = np.asarray(tree[name], np.float32).ravel()
+        res = None if residuals is None else residuals.get(
+            name, np.zeros_like(leaf))
+        parts, r = codec.encode(leaf, res, *path, i)
+        for part, arr in parts.items():
+            arrays[f"{name}.{part}"] = arr
+        if res_new is not None:
+            res_new[name] = r
+    return arrays, res_new
+
+
+def decode_tree(codec: HostCodec, arrays: dict,
+                template: dict) -> dict:
+    """Inverse of :func:`encode_tree` under a shape template
+    ``{name: ndarray-like}`` (the model's known center layout)."""
+    out = {}
+    for name in sorted(template):
+        shape = np.asarray(template[name]).shape
+        length = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        prefix = f"{name}."
+        parts = {k[len(prefix):]: v for k, v in arrays.items()
+                 if k.startswith(prefix)}
+        out[name] = codec.decode(parts, length).reshape(shape)
+    return out
+
+
+def zero_residuals(template: dict) -> dict:
+    """Fresh EF residuals for a tree template — one flat f32 zero
+    vector per leaf (what a brand-new or reset worker carries)."""
+    return {name: np.zeros(
+        int(np.prod(np.asarray(template[name]).shape,
+                    dtype=np.int64)), np.float32)
+        for name in template}
 
 
 def emit_overlap_counters(hidden_ms: float, comm_ms: float) -> None:

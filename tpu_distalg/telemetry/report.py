@@ -324,6 +324,29 @@ def render(s: dict) -> str:
                 f"{c.get('cluster.heartbeat_retries', 0)} heartbeat "
                 f"retr(ies), {c.get('cluster.dedup_pushes', 0)} "
                 f"deduped re-push(es))")
+        wire_tx = (s["counters"].get("cluster.wire_push_bytes", 0)
+                   + s["counters"].get("cluster.wire_center_bytes", 0))
+        if wire_tx:
+            # compressed cluster wire (cluster/ + the comms host
+            # codecs): measured frame bytes by direction, how many
+            # pulls rode version deltas vs fell back to dense
+            # snapshots (resume/rejoin), and how many pushes
+            # overlapped the next window's compute
+            c = s["counters"]
+
+            def _mb(n):
+                return (f"{n / 1e6:.2f} MB" if n >= 10_000
+                        else f"{n / 1e3:.1f} KB")
+
+            lines.append(
+                f"cluster wire: "
+                f"{_mb(c.get('cluster.wire_push_bytes', 0))} pushed "
+                f"/ {_mb(c.get('cluster.wire_center_bytes', 0))} "
+                f"pulled "
+                f"({c.get('cluster.delta_pulls', 0)} delta pull(s), "
+                f"{c.get('cluster.pull_dense_fallbacks', 0)} dense "
+                f"fallback(s), {c.get('cluster.async_pushes', 0)} "
+                f"overlapped push(es))")
         resh = s["counters"].get("reshard.syncs")
         if resh:
             # device-side resharding (parallel/partition.py): layout
