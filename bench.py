@@ -1448,9 +1448,144 @@ def run_cluster_wire_bench(emit, *, fast: bool = False,
     emit(line)
 
 
+def run_cluster_serve_bench(emit, *, fast: bool = False):
+    """The serving plane's headline triplet (cluster/serve.py +
+    cluster/router.py) — host threads by construction, so like the
+    training cluster it is honest on every backend:
+
+    ``cluster_serve_qps`` — closed-loop throughput of an undisturbed
+    burst through the router against a 3-replica kmeans fleet
+    (least-loaded dispatch, micro-batched replicas).
+
+    ``cluster_serve_p99_under_kill_ms`` — CLIENT-observed p99 latency
+    (first submit to final answer, retries and backoff included) of
+    the same burst while one replica dies to a seeded
+    ``cluster:replica`` kill mid-burst and the router re-routes the
+    stranded requests. The router-side per-attempt latency would hide
+    the re-route cost; the client clock is the one the kill taxes.
+
+    ``cluster_serve_availability`` — fraction of that disturbed
+    burst's requests answered on the FIRST client attempt: transparent
+    internal re-routes keep it at 1.0; only sheds and dead windows the
+    client must retry through lower it.
+
+    All three RAISE instead of emitting fabricated values when the
+    burst fails to complete, when the seeded kill never fires (the
+    p99/availability pair would describe an undisturbed run), or when
+    the disturbed replies diverge bitwise from the undisturbed burst's
+    (a fast answer that is wrong is not a served request)."""
+    import numpy as _np
+
+    from tpu_distalg.cluster import serve as cserve
+    from tpu_distalg.faults import registry as fregistry
+
+    dim, k = 16, 8
+    n_req = 96 if fast else 384
+    rng = _np.random.default_rng(13)
+    center = {"centers":
+              rng.standard_normal((k, dim)).astype(_np.float32)}
+    payloads = list(rng.standard_normal(
+        (n_req, dim)).astype(_np.float32))
+    cfg = cserve.FleetConfig(kind="kmeans", n_replicas=3, version=1,
+                             max_delay_ms=1.0)
+
+    fleet = cserve.ServeFleet(cfg, center).start()
+    try:
+        res_a, info_a = cserve.run_fleet_closed_loop(
+            fleet, payloads, concurrency=8)
+    finally:
+        fleet.stop()
+    if info_a["failed"] or info_a["ok"] != n_req:
+        raise RuntimeError(
+            f"undisturbed serve burst incomplete ({info_a['ok']}/"
+            f"{n_req} ok, {info_a['failed']} failed) — refusing to "
+            f"fabricate a throughput")
+    emit({
+        "metric": "cluster_serve_qps",
+        "value": info_a["qps"],
+        "unit": "req/s",
+        "vs_baseline": None,
+        "n_requests": n_req, "n_replicas": 3,
+        "policy": cfg.policy, "concurrency": 8,
+        "p99_clean_ms": info_a["p99_ms"],
+        "note": "closed-loop burst through the router against a "
+                "3-replica kmeans fleet, least-loaded dispatch, "
+                "micro-batched replicas — host threads by "
+                "construction, honest on every backend",
+    })
+
+    # disturbed arm: the SAME burst with one replica killed by a
+    # seeded plan mid-burst (hit counts score frames fleet-wide);
+    # client retries span the router's heartbeat/revival cadence so a
+    # shed window is a latency, never a lost request
+    hit = 7 if fast else 13
+    plan = f"seed=13;cluster:replica@{hit}=kill"
+    fregistry.configure(plan)
+    try:
+        fleet = cserve.ServeFleet(cfg, center).start()
+        try:
+            res_b, info_b = cserve.run_fleet_closed_loop(
+                fleet, payloads, concurrency=8, retries=10,
+                retry_backoff_s=0.05)
+            st = fleet.stats()
+            killed = [r.slot for r in fleet.replicas if r.killed]
+        finally:
+            fleet.stop()
+    finally:
+        fregistry.configure(False)
+    if not killed:
+        raise RuntimeError(
+            "the seeded replica kill never fired — the p99/"
+            "availability pair would describe an undisturbed run")
+    if info_b["failed"] or info_b["ok"] != n_req:
+        raise RuntimeError(
+            f"disturbed serve burst incomplete ({info_b['ok']}/"
+            f"{n_req} ok, {info_b['failed']} failed) — refusing to "
+            f"fabricate a kill-latency")
+    for j, (a, b) in enumerate(zip(res_a, res_b)):
+        if not _np.array_equal(_np.asarray(a[0]), _np.asarray(b[0])):
+            raise RuntimeError(
+                f"disturbed reply {j} diverged bitwise from the "
+                f"undisturbed burst — re-routing must not tax "
+                f"correctness; refusing to emit its latency")
+    emit({
+        "metric": "cluster_serve_p99_under_kill_ms",
+        "value": info_b["p99_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "killed_replicas": killed, "reroutes": st["reroutes"],
+        "client_retries": info_b["retries"],
+        "p50_under_kill_ms": info_b["p50_ms"],
+        "bitwise_vs_undisturbed": True,
+        "plan": plan,
+        "note": "client-observed p99 (first submit to final answer, "
+                "retries included) of the same burst with one "
+                "replica killed mid-burst by a seeded plan; every "
+                "reply asserted bitwise-identical to the undisturbed "
+                "burst's",
+    })
+    emit({
+        "metric": "cluster_serve_availability",
+        "value": info_b["availability"],
+        "unit": "fraction",
+        "vs_baseline": None,
+        "killed_replicas": killed, "sheds": st["sheds"],
+        "plan": plan,
+        "note": "fraction of the disturbed burst answered on the "
+                "FIRST client attempt — transparent internal "
+                "re-routes keep it at 1.0; only sheds and dead "
+                "windows the client retries through lower it",
+    })
+
+
 def _bench_cluster(mesh, n_chips):
     del mesh, n_chips  # the cluster builds its own local worker meshes
     run_cluster_bench(_emit)
+
+
+def _bench_cluster_serve(mesh, n_chips):
+    del mesh, n_chips  # host-thread fleet: no device mesh involved
+    run_cluster_serve_bench(_emit)
 
 
 def _bench_ssp(mesh, n_chips, sync="bsp"):
@@ -2794,6 +2929,9 @@ ALL_METRIC_NAMES = (
     "reshard_1gb_gbps",
     "ssgd_2d_mesh_step_speedup",
     "closure_10m_paths_per_sec",
+    "cluster_serve_qps",
+    "cluster_serve_p99_under_kill_ms",
+    "cluster_serve_availability",
 )
 
 #: metrics where LOWER is better (latencies; the SSP steps-to-target
@@ -2802,7 +2940,8 @@ ALL_METRIC_NAMES = (
 LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",
                                      "ssgd_ssp_equal_loss_steps",
                                      "cluster_push_pull_ms",
-                                     "cluster_coordinator_recovery_ms"))
+                                     "cluster_coordinator_recovery_ms",
+                                     "cluster_serve_p99_under_kill_ms"))
 
 #: canonical units, for the skipped-with-zero lines
 _METRIC_UNITS = {
@@ -2831,6 +2970,9 @@ _METRIC_UNITS = {
         "tokens/s/chip",
     "serve_als_qps": "req/s",
     "serve_lr_p99_ms": "ms",
+    "cluster_serve_qps": "req/s",
+    "cluster_serve_p99_under_kill_ms": "ms",
+    "cluster_serve_availability": "fraction",
     "reshard_1gb_gbps": "GB/s",
     "ssgd_2d_mesh_step_speedup": "x",
     "closure_10m_paths_per_sec": "paths/s",
@@ -3126,6 +3268,10 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
     _phase_optional(
         "cpu_cluster",
         functools.partial(run_cluster_bench, _cpu_emit, fast=fast))
+    _phase_optional(
+        "cpu_cluster_serve",
+        functools.partial(run_cluster_serve_bench, _cpu_emit,
+                          fast=fast))
     _phase_optional("cpu_pagerank", cpu_pagerank)
     _phase_optional("cpu_pagerank_streamed", cpu_pagerank_streamed)
     _phase_optional(
@@ -3265,6 +3411,10 @@ def _run(args):
             # construction, so it runs (honestly) on every backend;
             # raises rather than fabricating on an incomplete run
             _phase_optional("cluster", _bench_cluster, mesh, n_chips)
+            # the serving plane rides the same host-thread honesty;
+            # raises on an unfired kill or a bitwise divergence
+            _phase_optional("cluster_serve", _bench_cluster_serve,
+                            mesh, n_chips)
             # optional, and BOTH raise instead of emitting fabricated
             # lines on failure (the serve-round-3 / ssp lesson): a
             # parity miss or a refused capacity is a recorded phase

@@ -124,6 +124,18 @@ CLAIMS = [
      r"\*\*ALS serving[^*]*\*\*:\s*\*\*([\d\s.]+?)\+\s*req/s", 1.0),
     ("serve_lr_p99_ms",
      r"LR scoring p99 under \*\*([\d.]+?)\s*ms\*\*", 1.0),
+    # distributed serving plane (round 19): throughput and first-try
+    # availability claimed as FLOORS, the client p99 under a replica
+    # kill as a CEILING — the fleet is host threads/processes by
+    # construction, so like the training cluster the numbers are
+    # honest on every backend
+    ("cluster_serve_qps",
+     r"serving router\s+sustains \*\*([\d\s]+?)\+\s*req/s\*\*", 1.0),
+    ("cluster_serve_p99_under_kill_ms",
+     r"replica kill -9 mid-burst\s+keeps client p99 under "
+     r"\*\*([\d.]+?)\s*ms\*\*", 1.0),
+    ("cluster_serve_availability",
+     r"first-try availability at \*\*([\d.]+?)\+\*\*", 1.0),
     # partition-engine round (round 15): all three claimed as FLOORS
     # until the first real-backend round records achieved numbers
     # (cpu-tagged fallback lines cannot serve as the reference)
@@ -146,6 +158,8 @@ FLOOR_CLAIMS = frozenset((
     "ssgd_ssp_straggler_speedup",
     "ssgd_cluster_elastic_speedup",
     "cluster_wire_reduction_vs_dense",
+    "cluster_serve_qps",
+    "cluster_serve_availability",
     "reshard_1gb_gbps",
     "ssgd_2d_mesh_step_speedup",
     "closure_10m_paths_per_sec",
@@ -159,6 +173,7 @@ CEILING_CLAIMS = frozenset((
     "ssgd_ssp_equal_loss_steps",
     "cluster_push_pull_ms",
     "cluster_coordinator_recovery_ms",
+    "cluster_serve_p99_under_kill_ms",
 ))
 
 

@@ -8,7 +8,9 @@ timeout (the dispatch loop must keep observing its stop flag, and a
 wedged producer must surface as a timeout, not a silent hang — the same
 lesson ``data/pipeline.Prefetcher``'s liveness guard encodes). One
 forgotten ``queue.Queue()`` or bare ``.get()`` silently voids both;
-TDA060 makes the convention machine-checked for ``tpu_distalg/serve/``.
+TDA060 makes the convention machine-checked for ``tpu_distalg/serve/``
+and the distributed serving plane (``cluster/serve.py``,
+``cluster/router.py``), which carries the identical contract over TCP.
 
 Flagged shapes::
 
@@ -75,7 +77,13 @@ class ServeLivenessDiscipline(Rule):
                  "flags and wedged producers are always observable")
 
     def applies(self, ctx):
-        return "tpu_distalg/serve/" in ctx.path
+        # the serving PLANE, not just the serve/ package: the cluster
+        # router and replica modules carry the same bounded-queue /
+        # observable-stop availability contract over TCP
+        if "tpu_distalg/serve/" in ctx.path:
+            return True
+        return ctx.path.endswith(("tpu_distalg/cluster/serve.py",
+                                  "tpu_distalg/cluster/router.py"))
 
     def check(self, ctx):
         for node in ast.walk(ctx.tree):

@@ -444,12 +444,17 @@ def main(argv=None):
              "window and training continues at reduced quorum; a "
              "fresh worker rejoins by pulling the center")
     p.add_argument("--role", default="local",
-                   choices=["coordinator", "worker", "local"],
+                   choices=["coordinator", "worker", "local",
+                            "replica", "router"],
                    help="coordinator = serve rendezvous/clock/PS on "
                         "--host:--port; worker = join a coordinator at "
                         "--connect; local = spawn a coordinator plus "
                         "--workers N workers on this machine (the "
-                        "test/bench mode)")
+                        "test/bench mode); replica = one serving "
+                        "replica of the distributed serving plane "
+                        "(loads --artifact, scores over the framed "
+                        "transport, hot-swappable); router = the "
+                        "serving front end dispatching at --replicas")
     p.add_argument("--workers", type=int, default=3,
                    help="worker slot count (coordinator/local roles)")
     p.add_argument("--spawn", default="process",
@@ -543,6 +548,42 @@ def main(argv=None):
                         "TrainTask as JSON (the local launcher's "
                         "subprocess handoff — every field, not just "
                         "--algo/--n-rows; overrides both)")
+    p.add_argument("--artifact", type=str, default=None,
+                   metavar="CKPT_DIR",
+                   help="replica role: checkpoint directory to serve "
+                        "(the artifact_path: line a training CLI "
+                        "prints)")
+    p.add_argument("--replica-shards", type=int, default=1,
+                   help="replica role: total model-axis shard count "
+                        "of the fleet this replica belongs to")
+    p.add_argument("--shard", type=int, default=0,
+                   help="replica role: this replica's model-axis "
+                        "shard index")
+    p.add_argument("--k-top", type=int, default=10,
+                   help="serving plane: top-k candidates per ALS "
+                        "retrieval request")
+    p.add_argument("--merge", default="sparse",
+                   choices=["sparse", "dense"],
+                   help="serving plane: cross-replica ALS candidate "
+                        "merge — sparse (value,index) pair merge or "
+                        "the dense score-block all-gather baseline")
+    p.add_argument("--replicas", type=str, default=None,
+                   metavar="HOST:PORT[,HOST:PORT...]",
+                   help="router role: the replica fleet's addresses")
+    p.add_argument("--dispatch", default="least_loaded",
+                   choices=["least_loaded", "consistent_hash"],
+                   help="router role: dispatch policy")
+    p.add_argument("--serve-mode", default="routed",
+                   choices=["routed", "sharded"],
+                   help="router role: routed = each request to ONE "
+                        "replica (redundancy, re-route on death); "
+                        "sharded = fan out to every model-axis shard "
+                        "and merge candidates")
+    p.add_argument("--wal-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="router role: durable admission/routing WAL — "
+                        "a restarted router replays it and rebinds "
+                        "the same port")
     p.add_argument("--deadline", type=float, default=600.0,
                    help="local/coordinator roles: give up if the run "
                         "is still incomplete after this many seconds")
@@ -566,7 +607,8 @@ def main(argv=None):
     p.add_argument("--workload", default="lr",
                    choices=["lr", "ssgd", "kmeans", "als",
                             "kmeans_stream", "pagerank_stream",
-                            "serve", "ssp", "cluster"])
+                            "serve", "ssp", "cluster",
+                            "cluster_serve"])
     p.add_argument("--n-slices", type=int, default=0)
     _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=None,
@@ -733,6 +775,8 @@ def _run_cluster(args):
     from tpu_distalg import telemetry
     from tpu_distalg.parallel import ssp as pssp
 
+    if args.role in ("replica", "router"):
+        return _run_serving_plane(args)
     spec = pssp.SyncSpec.parse(args.sync)
     if not spec.is_ssp:
         raise SystemExit(
@@ -813,6 +857,61 @@ def _run_cluster(args):
         "event_digest": res.get("event_digest",
                                 None) or event_digest(res),
     }, default=float))
+    return 0
+
+
+def _run_serving_plane(args):
+    """``tda cluster --role {replica,router}`` — the distributed
+    serving plane's two process kinds. Both park until --deadline (or
+    a kill); the port announcement line is the launcher handshake."""
+    err = lambda m: print(m, file=sys.stderr)  # noqa: E731
+    if args.role == "replica":
+        from tpu_distalg.cluster import serve as cserve
+
+        if not args.artifact:
+            raise SystemExit("--role replica needs --artifact "
+                             "CKPT_DIR")
+        rep = cserve.run_replica(
+            args.slot or 0, args.artifact, shard=args.shard,
+            n_shards=args.replica_shards, k_top=args.k_top,
+            merge=args.merge, comm=args.comm, host=args.host,
+            port=args.port, logger=err)
+        print(f"cluster_replica: listening on "
+              f"{args.host}:{rep.port}", flush=True)
+        deadline = time.monotonic() + args.deadline
+        try:
+            while (time.monotonic() < deadline
+                   and not rep._stop.is_set()):
+                time.sleep(0.2)
+        finally:
+            rep.stop()
+        return 0
+    from tpu_distalg.cluster.router import Router, RouterConfig
+
+    if not args.replicas:
+        raise SystemExit("--role router needs --replicas "
+                         "HOST:PORT[,HOST:PORT...]")
+    addrs = []
+    for tok in args.replicas.split(","):
+        host, _, port = tok.strip().rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    router = Router(RouterConfig(
+        replicas=tuple(addrs), mode=args.serve_mode,
+        policy=args.dispatch, comm=args.comm, port=args.port,
+        wal_dir=args.wal_dir, k_top=args.k_top, merge=args.merge,
+        hb_interval=args.heartbeat_interval,
+        hb_timeout=args.heartbeat_timeout,
+        rpc_deadline=args.rpc_deadline), logger=err).start()
+    print(f"cluster_router: listening on "
+          f"{args.host}:{router.port}", flush=True)
+    deadline = time.monotonic() + args.deadline
+    try:
+        while (time.monotonic() < deadline
+               and not router._stop.is_set()):
+            time.sleep(0.2)
+        router.emit_gauges()
+    finally:
+        router.stop()
     return 0
 
 

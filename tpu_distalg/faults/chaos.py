@@ -80,7 +80,18 @@ from tpu_distalg import faults
 from tpu_distalg.telemetry import events as tevents
 
 WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream",
-             "pagerank_stream", "serve", "ssp", "cluster")
+             "pagerank_stream", "serve", "ssp", "cluster",
+             "cluster_serve")
+
+#: the serving fleet's availability floor under chaos: the fraction of
+#: requests answered on the FIRST client attempt (internal re-routes
+#: are transparent and don't count against it; sheds and re-route
+#: exhaustion do). A replica kill mid-burst must stay above this —
+#: redundancy, not luck. A pure kill plan sits at ~1.0 (re-routes are
+#: internal); the headroom below is for the cluster:rpc oserror storm
+#: grid, where every request crosses several injectable seams and a
+#: fraction legitimately needs one client retry
+CLUSTER_SERVE_AVAILABILITY_BAND = 0.85
 
 #: the ssp workload's convergence band: |chaos final acc − undisturbed
 #: final acc| must stay inside it (a straggled + leave/rejoin run walks
@@ -120,6 +131,22 @@ class ClusterChaosResult:
     event_digest: np.ndarray
     recoveries: int
     recovery_ms: list
+
+
+@dataclasses.dataclass
+class ClusterServeChaosResult:
+    """The cluster_serve workload's comparison surface: the stacked
+    router replies for the fixed request sequence (bitwise — replicas
+    score with fixed-shape host kernels, so a re-routed request's
+    reply is identical to the undisturbed run's). Availability and the
+    degradation counts ride along for the band verdict and the tests'
+    the-kill-really-fired assertions; they never enter the compare."""
+
+    replies: np.ndarray
+    availability: float
+    sheds: int
+    reroutes: int
+    client_retries: int
 
 
 @dataclasses.dataclass
@@ -163,6 +190,8 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
     if workload == "pagerank_stream":
         return {"ranks": np.asarray(res.ranks)}
     if workload == "serve":
+        return {"replies": np.asarray(res.replies)}
+    if workload == "cluster_serve":
         return {"replies": np.asarray(res.replies)}
     raise ValueError(f"unknown chaos workload {workload!r}; choose from "
                      f"{WORKLOADS}")
@@ -314,6 +343,50 @@ def _make_runner(workload: str, mesh, n_iterations: int | None,
             return m.train(*data, mesh, cfg, checkpoint_dir=ckpt_dir,
                            checkpoint_every=every)
         return run
+    if workload == "cluster_serve":
+        from tpu_distalg.cluster import serve as cserve
+
+        # a fixed synthetic center + request sequence: the chaos
+        # surface is the serving PLANE (dispatch, re-route, shed,
+        # cluster:rpc wire faults), not training — and the fixed-shape
+        # host scorers make every reply bitwise-reproducible no matter
+        # which replica ends up answering it
+        rng = np.random.default_rng(7)
+        center = {"centers": rng.normal(
+            size=(8, 16)).astype(np.float32)}
+        X_req = rng.normal(
+            size=(n_iterations or 96, 16)).astype(np.float32)
+
+        def run(ckpt_dir):
+            del ckpt_dir  # recovery = re-route + client retry
+            fleet = cserve.ServeFleet(cserve.FleetConfig(
+                kind="kmeans", n_replicas=3, version=1,
+                max_delay_ms=1.0), center).start()
+            try:
+                # backoff × retries must span the router's revival
+                # sweep (hb_interval): an oserror storm can condemn
+                # the whole fleet for one beat, and a client that
+                # burns its retries inside that beat fails a request
+                # the next beat would have answered
+                results, info = cserve.run_fleet_closed_loop(
+                    fleet, list(X_req), concurrency=4, retries=10,
+                    retry_backoff_s=0.05)
+                if info["failed"]:
+                    # out of retry budget — restartable, not a verdict
+                    raise RuntimeError(
+                        f"cluster_serve chaos: {info['failed']} "
+                        f"request(s) still failed after retries")
+                st = fleet.stats()
+                return ClusterServeChaosResult(
+                    replies=np.stack([np.asarray(v)
+                                      for v, _ver, _rid in results]),
+                    availability=float(info["availability"]),
+                    sheds=int(st["sheds"]),
+                    reroutes=int(st["reroutes"]),
+                    client_retries=int(info["retries"]))
+            finally:
+                fleet.stop()
+        return run
     if workload == "serve":
         import os
 
@@ -389,8 +462,10 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     runner = _make_runner(workload, mesh, n_iterations, checkpoint_every,
                           workdir, spawn=spawn, comm=comm)
     # kmeans_stream recovers by deterministic re-run, serve by
-    # shed-and-client-retry — neither consumes a checkpoint dir
-    uses_ckpt = workload not in ("kmeans_stream", "serve")
+    # shed-and-client-retry, cluster_serve by re-route-and-retry —
+    # none consumes a checkpoint dir
+    uses_ckpt = workload not in ("kmeans_stream", "serve",
+                                 "cluster_serve")
 
     def dirpath(name):
         d = os.path.join(workdir, name)
@@ -454,6 +529,15 @@ def run_chaos(workload: str, mesh, *, plan, workdir: str,
     else:
         mismatched = [name for name, a in ref_leaves.items()
                       if not np.array_equal(a, got_leaves[name])]
+        if workload == "cluster_serve":
+            # bitwise replies alone would pass a fleet that answered
+            # every request on its fifth retry — availability is the
+            # second half of the verdict, checked against a pinned band
+            avail = float(got.availability)
+            if avail < CLUSTER_SERVE_AVAILABILITY_BAND:
+                mismatched.append(
+                    f"band:availability ({avail:.4f} < "
+                    f"{CLUSTER_SERVE_AVAILABILITY_BAND})")
     result = ChaosResult(
         workload=workload, plan_spec=plan.spec(),
         equal=not mismatched, mismatched=mismatched, fired=fired,

@@ -44,6 +44,12 @@ Injection points (wired at the call sites named):
                     record bytes (replay's CRC truncates the tail
                     with a quarantine), ``oserror``/``hang`` model
                     transient disk faults
+  ``cluster:replica``  the serving replica's per-score-frame seam
+                    (``cluster/serve.py``) — ``kill`` = the replica
+                    SIGKILLs itself mid-burst (thread mode slams its
+                    sockets for the same router-side EOF observable),
+                    ``hang`` = a frozen replica the router's
+                    heartbeat timeout must detect and route around
 
   ``ckpt:write``    ``utils/checkpoint.save`` — the bytes about to land
                     on disk (``corrupt`` really flips file bytes; the
@@ -136,6 +142,7 @@ POINTS = (
     "cluster:rpc",
     "cluster:coordinator",
     "cluster:wal",
+    "cluster:replica",
 )
 
 KINDS = ("oserror", "hang", "corrupt", "kill", "straggle", "leave")
@@ -169,6 +176,10 @@ _POINT_KINDS = {
     # (the replay CRC quarantines the tail), oserror a transient disk
     # fault, hang a slow fsync
     "cluster:wal": ("oserror", "hang", "corrupt"),
+    # the serving replica's score seam (cluster/serve.py): kill = a
+    # real SIGKILL mid-burst (thread mode slams the replica's sockets
+    # so the router sees the same EOF), hang = a frozen replica
+    "cluster:replica": ("kill", "hang"),
 }
 
 DEFAULT_HANG_SECONDS = 0.05

@@ -1110,6 +1110,30 @@ def decode_tree(codec: HostCodec, arrays: dict,
     return out
 
 
+def merge_topk_pairs_host(all_vals, all_idx, *, k: int):
+    """Host spelling of ``ops.pallas_topk.merge_topk_pairs`` — the
+    cross-PROCESS half of the sparse candidate merge. A router holding
+    per-replica (S, B, K) pair stacks gathered over the framed
+    transport merges them with the same two-key order the in-process
+    ring all-gather path uses: value DESCENDING, ties toward the LOWER
+    global index (``lax.top_k``'s rule). Scores are computed and
+    compared as the same f32 bits on both paths, so routed sharded
+    replies stay bitwise-identical to a single-replica run."""
+    v = np.moveaxis(np.asarray(all_vals, np.float32), 0, 1)
+    i = np.moveaxis(np.asarray(all_idx, np.int32), 0, 1)
+    B = v.shape[0]
+    v = v.reshape(B, -1)
+    i = i.reshape(B, -1)
+    out_v = np.empty((B, k), np.float32)
+    out_i = np.empty((B, k), np.int32)
+    for b in range(B):
+        # lexsort: LAST key is primary — (-value asc, index asc)
+        order = np.lexsort((i[b], -v[b]))[:k]
+        out_v[b] = v[b][order]
+        out_i[b] = i[b][order]
+    return out_v, out_i
+
+
 def zero_residuals(template: dict) -> dict:
     """Fresh EF residuals for a tree template — one flat f32 zero
     vector per leaf (what a brand-new or reset worker carries)."""

@@ -353,15 +353,13 @@ def _restore_with_reread(path: str):
             return out
 
 
-def load_artifact(path: str, mesh, *, name: str | None = None,
-                  k_top: int = 10, merge: str = "sparse",
-                  use_fused: bool | None = None,
-                  block_items: int = 1024) -> ServedModel:
-    """Open a training checkpoint directory as a :class:`ServedModel`,
-    dispatching on the checkpoint's workload tag (the same tag
-    ``run_segmented`` verifies on resume). The ``tda serve --artifact``
-    path — pair it with the ``artifact_path:`` line the training CLIs
-    print."""
+def load_artifact_state(path: str) -> tuple:
+    """The jax-free half of :func:`load_artifact`: restore the newest
+    checkpoint (with the re-read degradation), verify the tagged
+    format, and return ``(tag_root, state_leaves, step)`` raw. The
+    cluster serving replicas (``cluster/serve.py``) ride this — they
+    score with host numpy kernels and must not pull a jax mesh into
+    every replica process just to read weights."""
     payload, step = _restore_with_reread(path)
     if "tag" not in payload or "state" not in payload:
         raise ValueError(
@@ -371,6 +369,19 @@ def load_artifact(path: str, mesh, *, name: str | None = None,
     state = [np.asarray(x) for x in payload["state"]]
     root = tag.split(":", 1)[0]
     tevents.emit("serve_artifact_loaded", path=path, tag=tag, step=step)
+    return root, state, step
+
+
+def load_artifact(path: str, mesh, *, name: str | None = None,
+                  k_top: int = 10, merge: str = "sparse",
+                  use_fused: bool | None = None,
+                  block_items: int = 1024) -> ServedModel:
+    """Open a training checkpoint directory as a :class:`ServedModel`,
+    dispatching on the checkpoint's workload tag (the same tag
+    ``run_segmented`` verifies on resume). The ``tda serve --artifact``
+    path — pair it with the ``artifact_path:`` line the training CLIs
+    print."""
+    root, state, _step = load_artifact_state(path)
     if root in _LR_TAG_ROOTS:
         return lr_model(state[0], name=name or root, source=path)
     if root.startswith("kmeans"):
@@ -382,6 +393,6 @@ def load_artifact(path: str, mesh, *, name: str | None = None,
                          block_items=block_items,
                          name=name or "als", source=path)
     raise ValueError(
-        f"checkpoint under {path} holds workload {tag!r} — no serving "
+        f"checkpoint under {path} holds workload {root!r} — no serving "
         f"adapter for it (servable: {', '.join(_LR_TAG_ROOTS)}, "
         f"kmeans_*, als)")

@@ -263,6 +263,29 @@ def render(s: dict) -> str:
                 f"p50 {g.get('serve.p50_ms', '?')} ms / "
                 f"p99 {g.get('serve.p99_ms', '?')} ms, {shed} shed, "
                 f"max queue depth {g.get('serve.queue_depth', '?')}")
+        creq = s["counters"].get("serve.cluster_requests")
+        if creq:
+            # the distributed serving plane (cluster/router.py
+            # emit_gauges + counters): router-side client latency,
+            # degradation evidence (sheds / re-routes), hot-swaps
+            g = s["gauges"]
+            lines.append(
+                f"cluster serve: {creq} request(s), "
+                f"{s['counters'].get('serve.cluster_replies', 0)} "
+                f"replied, {g.get('serve.cluster_qps', '?')} req/s, "
+                f"p50 {g.get('serve.cluster_p50_ms', '?')} ms / "
+                f"p99 {g.get('serve.cluster_p99_ms', '?')} ms, "
+                f"{s['counters'].get('serve.cluster_sheds', 0)} "
+                f"shed, "
+                f"{s['counters'].get('serve.cluster_reroutes', 0)} "
+                f"re-route(s), "
+                f"{s['counters'].get('serve.cluster_swaps', 0)} "
+                f"hot-swap(s)")
+            cmb = s["counters"].get("serve.cluster_merge_bytes_wire")
+            if cmb:
+                lines.append(
+                    f"cluster serve merge: {cmb} candidate bytes "
+                    f"over the wire (sharded top-k)")
         merges = s["counters"].get("ssp.merges")
         if merges:
             # the stale-synchronous layer (parallel/ssp.py): observed
