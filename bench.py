@@ -1448,6 +1448,96 @@ def run_cluster_wire_bench(emit, *, fast: bool = False,
     emit(line)
 
 
+def run_rowstore_bench(emit, *, fast: bool = False):
+    """The sharded row store's headline pair (cluster/rowstore.py),
+    shared by the bench ``rowstore`` phase and the CPU-fallback tier
+    (the fleet runs on host numpy + real wire frames by construction
+    — no TPU dependency, honest everywhere):
+
+    ``cluster_sparse_pull_fraction`` — MEASURED rank rows the fleet's
+    workers actually pulled per iteration over the dense baseline
+    (every worker pulling the whole vector): the reason a model
+    bigger than one host is trainable at all. Counted from the
+    workers' precomputed pull sets, not estimated from degree
+    statistics.
+
+    ``pagerank_cluster_iters_per_sec`` — full measured wall clock of
+    a cluster PageRank run through the row store: sparse pulls and
+    pushes through encoded wire frames, WAL row-redo records per
+    commit — the whole protocol, not a kernel microbenchmark.
+
+    Both RAISE instead of emitting fabricated values when the run
+    stops early, the rank invariant (Σranks ≈ 1) breaks, or the
+    'sparse' pulls turn out dense (fraction ≥ 1 means the claim is
+    dead, not small)."""
+    import os
+    import tempfile
+
+    import numpy as _np
+
+    from tpu_distalg import graphs
+    from tpu_distalg.cluster import rowstore
+
+    V = 2048 if fast else 8192
+    iters = 4 if fast else 8
+    shards = 4
+    with tempfile.TemporaryDirectory(prefix="tda_rowstore_") as d:
+        path = os.path.join(d, "graph")
+        graphs.build_powerlaw_block_cache(
+            path, n_vertices=V, n_shards=shards, avg_in_degree=8.0,
+            alpha=1.6, seed=3, block_edges=512)
+        res = rowstore.run_cluster_pagerank(
+            path, rowstore.ClusterPageRankConfig(
+                n_iterations=iters,
+                wal_dir=os.path.join(d, "wal")))
+    if res["version"] != iters:
+        raise RuntimeError(
+            f"rowstore pagerank stopped at iteration "
+            f"{res['version']}/{iters} — refusing to time an "
+            f"incomplete run")
+    rank_sum = float(_np.sum(res["ranks"], dtype=_np.float64))
+    if abs(rank_sum - 1.0) > 1e-2:
+        raise RuntimeError(
+            f"rank vector sums to {rank_sum:.6f}, not 1 — the "
+            f"protocol dropped mass; a rate from a wrong answer is "
+            f"not claimable")
+    frac = float(res["sparse_pull_fraction"])
+    if not 0.0 < frac < 1.0:
+        raise RuntimeError(
+            f"sparse pull fraction {frac} is not in (0, 1) — the "
+            f"pulls were dense (or the accounting broke); refusing "
+            f"to claim sparsity")
+    shared = {
+        "n_vertices": V, "n_workers": res["n_workers"],
+        "n_iterations": iters,
+        "peak_pull_rows": res["peak_pull_rows"],
+        "rank_sum": round(rank_sum, 6),
+    }
+    emit({
+        "metric": "cluster_sparse_pull_fraction",
+        "value": round(frac, 4),
+        "unit": "fraction",
+        "vs_baseline": None,
+        **shared,
+        "note": "measured rank rows pulled per iteration / dense "
+                "baseline (every worker pulls all V rows); from the "
+                "workers' actual pull sets on a power-law edge "
+                "cache — the >1-host-RAM story in one number",
+    })
+    emit({
+        "metric": "pagerank_cluster_iters_per_sec",
+        "value": round(res["iters_per_sec"], 3),
+        "unit": "iter/s",
+        "vs_baseline": None,
+        "elapsed_s": round(res["elapsed_s"], 3),
+        **shared,
+        "note": "full protocol wall clock: sparse row pulls/pushes "
+                "through encoded wire frames + WAL row-redo per "
+                "commit; rank invariant and completion asserted, "
+                "never assumed",
+    })
+
+
 def run_cluster_serve_bench(emit, *, fast: bool = False):
     """The serving plane's headline triplet (cluster/serve.py +
     cluster/router.py) — host threads by construction, so like the
@@ -1586,6 +1676,11 @@ def _bench_cluster(mesh, n_chips):
 def _bench_cluster_serve(mesh, n_chips):
     del mesh, n_chips  # host-thread fleet: no device mesh involved
     run_cluster_serve_bench(_emit)
+
+
+def _bench_rowstore(mesh, n_chips):
+    del mesh, n_chips  # host numpy fleet + wire frames: no device mesh
+    run_rowstore_bench(_emit)
 
 
 def _bench_ssp(mesh, n_chips, sync="bsp"):
@@ -2932,6 +3027,8 @@ ALL_METRIC_NAMES = (
     "cluster_serve_qps",
     "cluster_serve_p99_under_kill_ms",
     "cluster_serve_availability",
+    "cluster_sparse_pull_fraction",
+    "pagerank_cluster_iters_per_sec",
 )
 
 #: metrics where LOWER is better (latencies; the SSP steps-to-target
@@ -2941,7 +3038,8 @@ LOWER_IS_BETTER_METRICS = frozenset(("serve_lr_p99_ms",
                                      "ssgd_ssp_equal_loss_steps",
                                      "cluster_push_pull_ms",
                                      "cluster_coordinator_recovery_ms",
-                                     "cluster_serve_p99_under_kill_ms"))
+                                     "cluster_serve_p99_under_kill_ms",
+                                     "cluster_sparse_pull_fraction"))
 
 #: canonical units, for the skipped-with-zero lines
 _METRIC_UNITS = {
@@ -2973,6 +3071,8 @@ _METRIC_UNITS = {
     "cluster_serve_qps": "req/s",
     "cluster_serve_p99_under_kill_ms": "ms",
     "cluster_serve_availability": "fraction",
+    "cluster_sparse_pull_fraction": "fraction",
+    "pagerank_cluster_iters_per_sec": "iter/s",
     "reshard_1gb_gbps": "GB/s",
     "ssgd_2d_mesh_step_speedup": "x",
     "closure_10m_paths_per_sec": "paths/s",
@@ -3272,6 +3372,9 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
         "cpu_cluster_serve",
         functools.partial(run_cluster_serve_bench, _cpu_emit,
                           fast=fast))
+    _phase_optional(
+        "cpu_rowstore",
+        functools.partial(run_rowstore_bench, _cpu_emit, fast=fast))
     _phase_optional("cpu_pagerank", cpu_pagerank)
     _phase_optional("cpu_pagerank_streamed", cpu_pagerank_streamed)
     _phase_optional(
@@ -3415,6 +3518,10 @@ def _run(args):
             # raises on an unfired kill or a bitwise divergence
             _phase_optional("cluster_serve", _bench_cluster_serve,
                             mesh, n_chips)
+            # the sharded row store: host numpy + wire frames, honest
+            # everywhere; raises on an incomplete run, a broken rank
+            # invariant, or pulls that turn out dense
+            _phase_optional("rowstore", _bench_rowstore, mesh, n_chips)
             # optional, and BOTH raise instead of emitting fabricated
             # lines on failure (the serve-round-3 / ssp lesson): a
             # parity miss or a refused capacity is a recorded phase

@@ -116,6 +116,14 @@ CLAIMS = [
     ("cluster_wire_reduction_vs_dense",
      r"`--comm int8` cluster wire moves \*\*([\d.]+?)×\+ fewer\*\*",
      1.0),
+    # sharded-state parameter server (round 21): the fleet PageRank
+    # iteration rate is a FLOOR (host numpy + real wire frames —
+    # honest on every backend); the sparse-pull fraction is a CEILING
+    # (lower = sparser = the bigger-than-one-host story working)
+    ("pagerank_cluster_iters_per_sec",
+     r"sharded row store\*\*:\s*\*\*([\d\s.]+?)\+\s*iter/s\*\*", 1.0),
+    ("cluster_sparse_pull_fraction",
+     r"sparse-pull fraction under\s+\*\*([\d.]+?)\*\*", 1.0),
     # online serving layer (round 13): throughput claimed as a floor
     # and the scoring p99 as a CEILING until the first real-backend
     # round records the achieved numbers (cpu-tagged fallback lines
@@ -160,6 +168,7 @@ FLOOR_CLAIMS = frozenset((
     "cluster_wire_reduction_vs_dense",
     "cluster_serve_qps",
     "cluster_serve_availability",
+    "pagerank_cluster_iters_per_sec",
     "reshard_1gb_gbps",
     "ssgd_2d_mesh_step_speedup",
     "closure_10m_paths_per_sec",
@@ -174,6 +183,7 @@ CEILING_CLAIMS = frozenset((
     "cluster_push_pull_ms",
     "cluster_coordinator_recovery_ms",
     "cluster_serve_p99_under_kill_ms",
+    "cluster_sparse_pull_fraction",
 ))
 
 

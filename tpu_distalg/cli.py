@@ -515,6 +515,17 @@ def main(argv=None):
                         "compressed pushes overlap the next window's "
                         "compute on a background sender; append @seq "
                         "to force synchronous pushes (e.g. int8@seq)")
+    p.add_argument("--ps-mode", default="replicated",
+                   choices=["replicated", "rowstore"],
+                   help="PS tier state layout: replicated = each "
+                        "shard holds dense slices, merges whole "
+                        "deltas (the pre-rowstore protocol "
+                        "bit-for-bit); rowstore = shards own disjoint "
+                        "leading-dim row ranges (partition rule "
+                        "table), pushes carry {leaf}.rows index "
+                        "arrays and merge row-wise with per-row "
+                        "versions — sparse pulls/pushes for models "
+                        "bigger than one host")
     p.add_argument("--policy", default="elastic",
                    choices=["elastic", "restart"],
                    help="death handling: elastic = continue at "
@@ -608,7 +619,7 @@ def main(argv=None):
                    choices=["lr", "ssgd", "kmeans", "als",
                             "kmeans_stream", "pagerank_stream",
                             "serve", "ssp", "cluster",
-                            "cluster_serve"])
+                            "cluster_serve", "rowstore"])
     p.add_argument("--n-slices", type=int, default=0)
     _add_mesh_shape(p)
     p.add_argument("--n-iterations", type=int, default=None,
@@ -626,11 +637,13 @@ def main(argv=None):
                         "the genuine subprocess kill -9 is 'tda "
                         "cluster --coordinator-spawn process')")
     p.add_argument("--comm", default="dense", metavar="SCHED",
-                   help="cluster workload only: the wire schedule "
-                        "both the undisturbed and the chaos run use "
-                        "(dense/int8[:seed]/topk[:frac]) — the "
-                        "compression×chaos composition acceptance is "
-                        "'tda chaos --workload cluster --comm int8'")
+                   help="cluster/rowstore workloads only: the wire "
+                        "schedule both the undisturbed and the chaos "
+                        "run use (dense/int8[:seed]/topk[:frac]) — "
+                        "the compression×chaos composition acceptance "
+                        "is 'tda chaos --workload cluster --comm "
+                        "int8' (and --workload rowstore for the "
+                        "sparse row wire)")
     p.add_argument("--workdir", type=str, default=None,
                    help="checkpoint scratch directory (default: a "
                         "fresh temp dir, removed on success)")
@@ -810,7 +823,7 @@ def _run_cluster(args):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         policy=args.policy, plan_spec=plan, comm=args.comm,
-        train=train)
+        ps_mode=args.ps_mode, train=train)
     if args.role == "coordinator":
         coord = clus.Coordinator(cfg).start()
         print(f"cluster_coordinator: listening on "

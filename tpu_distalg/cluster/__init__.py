@@ -19,11 +19,24 @@ reduced quorum; the seeded fault plan (``cluster:worker`` /
 ``cluster:rpc`` points) makes a chaos run — compressed or dense —
 replay to the identical merge/membership event sequence.
 
-See ``docs/ARCHITECTURE.md`` ("Multi-process elastic runtime") and
-``tda cluster --help``.
+``--ps-mode rowstore`` swaps the replicated PS tier for the SHARDED
+row store (``cluster/rowstore.py``): each PS shard owns a disjoint
+leading-dim row range under the model's partition rule table, pushes
+carry per-leaf ``{name}.rows`` index arrays and merge row-wise with
+per-row versions (``decay**age`` per ROW, not per delta), and the
+cluster graph engines (``run_cluster_pagerank``) pull only the rows
+an iteration touches — the model no longer has to fit one host.
+
+See ``docs/ARCHITECTURE.md`` ("Multi-process elastic runtime",
+"Sharded-state parameter server") and ``tda cluster --help``.
 """
 
-from tpu_distalg.cluster import ps, transport, wal
+from tpu_distalg.cluster import ps, rowstore, transport, wal
+from tpu_distalg.cluster.rowstore import (
+    ClusterPageRankConfig,
+    RowStore,
+    run_cluster_pagerank,
+)
 from tpu_distalg.cluster.coordinator import (
     ClusterAborted,
     ClusterConfig,
@@ -42,12 +55,16 @@ from tpu_distalg.cluster.worker import (
 __all__ = [
     "ClusterAborted",
     "ClusterConfig",
+    "ClusterPageRankConfig",
     "Coordinator",
+    "RowStore",
     "TrainTask",
     "center_accuracy",
     "compile_coordinator_schedule",
     "compile_worker_schedule",
     "ps",
+    "rowstore",
+    "run_cluster_pagerank",
     "run_local_cluster",
     "run_worker",
     "strip_kills",

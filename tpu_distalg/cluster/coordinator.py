@@ -204,9 +204,19 @@ class ClusterConfig:
     #: feedback on pushes; pulls ride the int8 codec — see
     #: ``worker.py``). ``@seq`` disables the async push overlap.
     comm: str = "dense"
+    #: PS state layout — ``replicated`` (every shard a row slice of a
+    #: center that must fit one host; the verbatim pre-rowstore path,
+    #: pinned bitwise) or ``rowstore`` (disjoint row ownership with
+    #: per-row versions: pushes carry ``{leaf}.rows`` index arrays and
+    #: merge row-wise — see ``cluster/rowstore.py``)
+    ps_mode: str = "replicated"
     train: TrainTask = dataclasses.field(default_factory=TrainTask)
 
     def __post_init__(self):
+        if self.ps_mode not in psmod.PS_MODES:
+            raise ValueError(
+                f"unknown ps_mode {self.ps_mode!r}; choose from "
+                f"{psmod.PS_MODES}")
         if self.n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {self.n_slots}")
         if self.staleness < 1:
@@ -292,7 +302,8 @@ class Coordinator:
         self.ps = psmod.ParameterServer(
             init_center(self.task), table=config.table,
             n_shards=config.ps_shards, decay=config.decay,
-            history_depth=self._history_depth)
+            history_depth=self._history_depth,
+            mode=config.ps_mode)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.slots = {i: SlotState() for i in range(config.n_slots)}
@@ -331,6 +342,14 @@ class Coordinator:
         self._coord_sched = compile_coordinator_schedule(
             config.n_windows, plan=plan)
         self._coord_fired: set[int] = set()
+        # the PS-shard fault schedule (cluster:ps — the merge seam,
+        # AFTER the commit record is durable): compiled by the shared
+        # rowstore compiler, one probe per window, same plan-purity
+        from tpu_distalg.cluster import rowstore as rowstoremod
+
+        self._ps_sched = rowstoremod.compile_point_schedule(
+            "cluster:ps", config.n_windows, plan=plan)[:, 0]
+        self._ps_fired: set[int] = set()
         self._maybe_resume()
         # seed the version history at whatever center recovery landed
         # on (replayed commits already recorded theirs inside merge)
@@ -368,7 +387,8 @@ class Coordinator:
             self.ps = psmod.ParameterServer(
                 center, table=self.cfg.table,
                 n_shards=self.cfg.ps_shards, decay=self.cfg.decay,
-                history_depth=self._history_depth)
+                history_depth=self._history_depth,
+                mode=self.cfg.ps_mode)
             self.version = int(step)
             self.ps.version = self.version
             # the restored base enters the version history BEFORE the
@@ -918,6 +938,7 @@ class Coordinator:
             "heartbeat_timeout": self.cfg.heartbeat_timeout,
             "rpc_deadline": self.cfg.rpc_deadline,
             "comm": self.cfg.comm,
+            "ps_mode": self.cfg.ps_mode,
             "plan": self.cfg.plan_spec,
             "train": self.task.as_meta(),
             "done": self.done,
@@ -1044,11 +1065,22 @@ class Coordinator:
         int32 widening before the one scale multiply, topk scatter-
         add) against the model's known center layout. The WAL and the
         idempotence digests see the COMPRESSED bytes — this decode is
-        a pure function of them, so replay stays bitwise."""
+        a pure function of them, so replay stays bitwise. A rowstore-
+        mode push's ``{leaf}.rows`` index arrays ride AROUND the codec
+        (they are exact int64 structure, not compressible values, and
+        their ``{leaf}.``-prefixed names would otherwise be mistaken
+        for codec parts) and re-attach to the decoded delta for the
+        PS's row-wise merge."""
         if self._codec is None:
             return arrays
-        return pcomms.decode_tree(self._codec, arrays,
-                                  self._center_template)
+        rows = {k: v for k, v in arrays.items()
+                if k.endswith(psmod.ROWS_SUFFIX)}
+        vals = {k: v for k, v in arrays.items()
+                if not k.endswith(psmod.ROWS_SUFFIX)}
+        out = pcomms.decode_tree(self._codec, vals,
+                                 self._center_template)
+        out.update(rows)
+        return out
 
     def _pull_reply(self, slot: int, window: int, have) -> tuple:
         """Lock held. The deferred push-ack's pull payload for a push
@@ -1302,6 +1334,28 @@ class Coordinator:
                  for k, v in d.items()})
             for c in wal_meta["contribs"]:
                 self.commit_digests[(w, c["slot"])] = c["digest"]
+            # the seeded PS-SHARD fault lands HERE — the commit record
+            # IS durable but the merge has not applied: a kill
+            # exercises the WAL's REDO half (recovery replays the
+            # record and re-applies the logged deltas; the coordinator
+            # cell above covers the rollback half), a hang freezes the
+            # shard merge everyone is waiting on
+            if w < self._ps_sched.shape[0] and \
+                    self._ps_sched[w] and \
+                    w not in self._ps_fired:
+                self._ps_fired.add(w)
+                cell = float(self._ps_sched[w])
+                if cell == COORD_KILL:
+                    tevents.emit("cluster_ps_kill", window=w)
+                    self._die()       # never returns (or raises)
+                time.sleep(cell)      # the frozen-shard cell: same
+                #                       liveness-clock reset as the
+                #                       coordinator freeze above
+                now_ = time.monotonic()
+                for st_ in self.slots.values():
+                    if st_.status == ACTIVE:
+                        st_.last_beat = now_
+                        st_.suspect_at = None
             # the WAL carried the COMPRESSED payload bytes (the redo
             # log replays bitwise); the exact host decode happens
             # here, strictly after durability, in slot order

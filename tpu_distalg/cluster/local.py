@@ -47,6 +47,7 @@ import time
 
 import numpy as np
 
+from tpu_distalg.cluster import rowstore as rowstoremod
 from tpu_distalg.cluster import transport
 from tpu_distalg.cluster import worker as workermod
 from tpu_distalg.cluster.coordinator import (
@@ -214,7 +215,7 @@ class _CoordSupervisor:
                 self.config, port=self.port,
                 plan_spec=workermod.strip_kills(
                     self.config.plan_spec,
-                    points=("cluster:coordinator",)))
+                    points=("cluster:coordinator", "cluster:ps")))
             self.coord = Coordinator(
                 self.config, die=lambda c: c.slam()).start()
             self.recoveries += 1
@@ -270,16 +271,20 @@ def run_local_cluster(config: ClusterConfig, *, spawn: str = "thread",
       a genuine ``kill -9``.
     """
     log = logger or (lambda m: None)
+    _plan = (fregistry.FaultPlan.parse(config.plan_spec)
+             if config.plan_spec else None)
     coord_sched = compile_coordinator_schedule(
-        config.n_windows,
-        plan=(fregistry.FaultPlan.parse(config.plan_spec)
-              if config.plan_spec else None))
-    if (coord_sched == COORD_KILL).any() and not config.checkpoint_dir:
+        config.n_windows, plan=_plan)
+    ps_sched = rowstoremod.compile_point_schedule(
+        "cluster:ps", config.n_windows, plan=_plan)[:, 0]
+    if ((coord_sched == COORD_KILL).any()
+            or (ps_sched == COORD_KILL).any()) \
+            and not config.checkpoint_dir:
         raise ValueError(
-            "a cluster:coordinator kill plan needs a checkpoint_dir: "
-            "the durable WAL (and the center checkpoints it sits on) "
-            "live under it — without one there is nothing to recover "
-            "from")
+            "a cluster:coordinator / cluster:ps kill plan needs a "
+            "checkpoint_dir: the durable WAL (and the center "
+            "checkpoints it sits on) live under it — without one "
+            "there is nothing to recover from")
     if coordinator_spawn == "process":
         return _run_process_coordinator(
             config, spawn=spawn, respawn=respawn,
@@ -468,6 +473,7 @@ class _ProcCoordinator:
                "--rpc-deadline", str(config.rpc_deadline),
                "--reconnect-grace", str(config.reconnect_grace),
                "--comm", config.comm,
+               "--ps-mode", config.ps_mode,
                # the EXACT TrainTask, every field — workers take the
                # task from the coordinator's welcome, so a lossy
                # handoff here would silently train a different task
@@ -588,10 +594,14 @@ def _run_process_coordinator(config: ClusterConfig, *, spawn,
     kill_cells: dict[int, int] = {}
     for w, slot in zip(*np.nonzero(schedule == workermod.KILL)):
         kill_cells.setdefault(int(slot), int(w))
-    coord_kill_expected = (compile_coordinator_schedule(
-        config.n_windows,
-        plan=(fregistry.FaultPlan.parse(config.plan_spec)
-              if config.plan_spec else None)) == COORD_KILL).any()
+    _plan = (fregistry.FaultPlan.parse(config.plan_spec)
+             if config.plan_spec else None)
+    coord_kill_expected = bool(
+        (compile_coordinator_schedule(
+            config.n_windows, plan=_plan) == COORD_KILL).any()
+        or (rowstoremod.compile_point_schedule(
+            "cluster:ps", config.n_windows,
+            plan=_plan)[:, 0] == COORD_KILL).any())
     pending_respawn = {}
     if respawn:
         for slot, w_kill in sorted(kill_cells.items()):
@@ -627,7 +637,7 @@ def _run_process_coordinator(config: ClusterConfig, *, spawn,
                 config = dataclasses.replace(
                     config, plan_spec=workermod.strip_kills(
                         config.plan_spec,
-                        points=("cluster:coordinator",)))
+                        points=("cluster:coordinator", "cluster:ps")))
                 pc = _ProcCoordinator(config, telemetry_dir,
                                       port=port)
                 recoveries += 1

@@ -37,28 +37,27 @@ import numpy as np
 from tpu_distalg.parallel import partition
 from tpu_distalg.parallel.ssp import DEFAULT_DECAY
 
+PS_MODES = ("replicated", "rowstore")
+
+#: suffix of a delta's per-leaf row-index array (rowstore mode): a
+#: push carrying ``{name}.rows`` moves ONLY those leading-dim rows of
+#: leaf ``name``; without it the delta is whole-leaf (rows 0..n)
+ROWS_SUFFIX = ".rows"
+
 
 def split_center(center: dict, table_name: str,
                  n_shards: int) -> list[dict]:
     """Per-PS-shard sub-dicts of ``center`` under the model's rule
     table: sharded-spec leaves row-split (uneven OK), replicated-spec
     leaves whole on shard 0. The union of the shards is exactly the
-    center (reassembled by :func:`join_center`)."""
-    if n_shards < 1:
-        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
-    tbl = partition.table(table_name)
-    shards: list[dict] = [{} for _ in range(n_shards)]
-    for name, leaf in center.items():
-        leaf = np.asarray(leaf)
-        spec = tbl.spec_for(name, leaf.shape)
-        sharded = any(entry is not None for entry in tuple(spec))
-        if sharded and leaf.ndim >= 1 and leaf.shape[0] >= 1:
-            for i, piece in enumerate(
-                    np.array_split(leaf, n_shards, axis=0)):
-                shards[i][name] = piece.copy()
-        else:
-            shards[0][name] = leaf.copy()
-    return shards
+    center (reassembled by :func:`join_center`). The slicing itself
+    lives in :class:`partition.RowOwnershipMap` — ONE derivation of
+    row ownership shared with the sharded row store
+    (``cluster/rowstore.py``) and the cluster graph/ALS engines; this
+    wrapper keeps the historical byte-level contract (it IS the old
+    ``np.array_split`` arithmetic, now table-driven in one place)."""
+    return partition.RowOwnershipMap.for_center(
+        center, table_name, n_shards).split(center)
 
 
 def join_center(shards: list[dict]) -> dict:
@@ -125,12 +124,28 @@ class ParameterServer:
 
     def __init__(self, center: dict, *, table: str = "lr",
                  n_shards: int = 2, decay: float = DEFAULT_DECAY,
-                 history_depth: int = 0):
+                 history_depth: int = 0, mode: str = "replicated",
+                 row_staleness: int | None = None):
+        if mode not in PS_MODES:
+            raise ValueError(
+                f"unknown ps mode {mode!r}; choose from {PS_MODES}")
         self.table = table
         self.decay = float(decay)
         self.n_shards = int(n_shards)
-        self.shards = [PsShard(s) for s in
-                       split_center(center, table, self.n_shards)]
+        self.mode = mode
+        if mode == "rowstore":
+            # deferred import: rowstore pulls the comms codec module
+            # (jax) — the replicated tier stays numpy-light
+            from tpu_distalg.cluster import rowstore as _rowstore
+
+            self.store = _rowstore.RowStore(
+                center, table=table, n_shards=self.n_shards,
+                decay=self.decay, staleness=row_staleness)
+            self.shards = []
+        else:
+            self.store = None
+            self.shards = [PsShard(s) for s in
+                           split_center(center, table, self.n_shards)]
         self._version_lock = threading.Lock()
         self.version = 0  # windows merged into the center
         self.history_depth = int(history_depth)
@@ -148,6 +163,8 @@ class ParameterServer:
         owns the ordering, which is what makes the merge sequence a
         pure function of the plan). Returns the per-contribution
         records ``[{slot, base, age, weight}]``; bumps ``version``."""
+        if self.mode == "rowstore":
+            return self._merge_rows(commit_window, contribs)
         records = []
         weighted: list[tuple[float, list[dict]]] = []
         for slot, base, delta in contribs:
@@ -166,6 +183,44 @@ class ParameterServer:
         for i, shard in enumerate(self.shards):
             shard.apply_weighted(
                 [(w, pieces[i]) for w, pieces in weighted])
+        with self._version_lock:
+            self.version = max(self.version, commit_window + 1)
+        self.record_history(commit_window + 1)
+        return records
+
+    def _merge_rows(self, commit_window: int,
+                    contribs: list[tuple[int, int, dict]]) -> list[dict]:
+        """Rowstore-mode commit: each delta's ``{name}.rows`` array
+        selects the leading-dim rows it moves (absent ⇒ whole leaf),
+        the contribution's scalar ``base`` becomes every row's base
+        version, and the weighted mean applies ROW-WISE in the
+        :class:`~tpu_distalg.cluster.rowstore.RowStore` — a whole-leaf
+        push at a uniform base merges bit-identically to the
+        replicated path (the pin the mode ships under)."""
+        records = []
+        row_contribs = []
+        for slot, base, delta in contribs:
+            age = max(0, commit_window - int(base))
+            records.append({"slot": int(slot), "base": int(base),
+                            "age": int(age),
+                            "weight": round(
+                                self.weight(self.decay, age), 6)})
+            leaf_deltas = {}
+            for name, vals in delta.items():
+                if name.endswith(ROWS_SUFFIX):
+                    continue
+                vals = np.asarray(vals, np.float32)
+                rows = delta.get(f"{name}{ROWS_SUFFIX}")
+                if rows is None:
+                    rows = np.arange(
+                        vals.shape[0] if vals.ndim else 1,
+                        dtype=np.int64)
+                    vals = vals.reshape((rows.shape[0],)
+                                        + vals.shape[1:])
+                leaf_deltas[name] = (np.asarray(rows, np.int64),
+                                     vals, int(base))
+            row_contribs.append((int(slot), leaf_deltas))
+        self.store.merge_rows(commit_window, row_contribs)
         with self._version_lock:
             self.version = max(self.version, commit_window + 1)
         self.record_history(commit_window + 1)
@@ -197,6 +252,8 @@ class ParameterServer:
 
     def snapshot(self) -> dict:
         """The assembled center (copies, consistent per shard)."""
+        if self.mode == "rowstore":
+            return self.store.snapshot()
         parts = []
         for shard in self.shards:
             with shard.lock:

@@ -61,6 +61,28 @@ the top of :meth:`WriteAheadLog.append` — ``corrupt`` really flips
 bytes (the replay CRC catches it as a quarantined tail), ``oserror``
 models a transient disk fault.
 
+Record kinds (meta ``k``, one frame each):
+
+  ``base``       segment header — full control-plane snapshot
+  ``admit``      slot admission + incarnation grant (fencing)
+  ``skip``       an announced busy-skip (dedup on replay)
+  ``commit``     one SSP window: slot-ordered contribution digests +
+                 the pushed delta bytes keyed ``{slot}/{leaf}`` — the
+                 redo record. In rowstore PS mode each contribution
+                 additionally carries its ``{slot}/{leaf}.rows``
+                 int64 row-index array (the per-ROW redo record: the
+                 replayed merge re-applies exactly those rows, and
+                 the digest covers the index bytes too)
+  ``rowcommit``  one row-store fleet commit (the cluster PageRank /
+                 ALS engines in ``cluster/rowstore.py``): per-slot
+                 sparse row pushes keyed ``{slot}/{leaf}.rows`` +
+                 ``{slot}/<codec parts>``, plus the combine's scalar
+                 meta (e.g. the dangling-mass sum) — replay re-runs
+                 the identical decode and row apply, bitwise
+  ``leave``      membership epoch transition (a declared death)
+  ``hold``       admission hold      ``bye``  worker departure
+  ``done``       run completion
+
 stdlib + numpy only, like the transport.
 """
 

@@ -635,6 +635,15 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
     comm_spec = pcomms.CommSpec.parse(meta.get("comm") or "dense")
     codec = pcomms.make_host_codec(comm_spec)
     pull_codec = pcomms.make_host_pull_codec(comm_spec)
+    # rowstore PS mode (the welcome carries it, like the comm spec):
+    # every push names the rows it moves via a ``w.rows`` index array
+    # so the PS merges row-wise. The SGD window HONESTLY touches every
+    # row of the dense LR weight vector, so the index is the full
+    # range — which is exactly what pins rowstore-mode SSP bitwise to
+    # the replicated path; genuine sparsity belongs to the row-store's
+    # graph/ALS workloads (``rowstore.run_cluster_pagerank``,
+    # ``models/als.fit_rowstore``)
+    ps_mode = meta.get("ps_mode") or "replicated"
     overlap_push = codec is not None and comm_spec.overlap
     push_link = (_Link(host, port, None, connect, ident, rpc_deadline,
                        stats, log) if overlap_push else None)
@@ -873,6 +882,15 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                     pcomms.PUSH_SEED_TAG, slot, window)
                 push_meta = dict(ident, window=window,
                                  base=push_base, have=have)
+            if ps_mode == "rowstore":
+                # the row index rides OUTSIDE the codec (exact int64
+                # structure; the coordinator detaches it before the
+                # value decode) and INSIDE the push digest — replay
+                # and re-push dedup cover it like any other byte
+                arrays_out["w.rows"] = np.arange(
+                    progress.shape[0], dtype=np.int64)
+                tevents.counter("rowstore.rows_pushed",
+                                int(progress.shape[0]))
             # the ack is DEFERRED until this window commits — which
             # can legitimately wait out an admission hold (a respawned
             # PROCESS worker pays spawn + jax import + first compile),

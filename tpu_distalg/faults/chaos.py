@@ -50,6 +50,16 @@ is the fault schedule, not the FLOPs:
                      the server keeps serving, the closed-loop client
                      retries) — recovery is shed-and-retry, and the
                      final reply set must still be bitwise-identical
+  ``rowstore``       cluster PageRank through the sharded row store
+                     (``tpu_distalg/cluster/rowstore.py``): per-worker
+                     sparse rank pulls/pushes through real wire
+                     frames, per-commit WAL row-redo records —
+                     seeded ``cluster:worker`` / ``cluster:coordinator``
+                     (rollback: kill BEFORE the redo record is durable)
+                     / ``cluster:ps`` (redo: kill AFTER the record,
+                     before the merge applies) / ``cluster:rpc`` faults
+                     all recover to a BITWISE-identical rank vector
+                     and commit-event digest, dense or compressed wire
   ``cluster``        the multi-process elastic runtime
                      (``tpu_distalg/cluster/``) under a COORDINATOR
                      kill (``cluster:coordinator`` plan rules): the
@@ -81,7 +91,7 @@ from tpu_distalg.telemetry import events as tevents
 
 WORKLOADS = ("lr", "ssgd", "kmeans", "als", "kmeans_stream",
              "pagerank_stream", "serve", "ssp", "cluster",
-             "cluster_serve")
+             "cluster_serve", "rowstore")
 
 #: the serving fleet's availability floor under chaos: the fraction of
 #: requests answered on the FIRST client attempt (internal re-routes
@@ -131,6 +141,20 @@ class ClusterChaosResult:
     event_digest: np.ndarray
     recoveries: int
     recovery_ms: list
+
+
+@dataclasses.dataclass
+class RowstoreChaosResult:
+    """The rowstore workload's comparison surface: the final rank
+    vector and the commit-event digest (as bytes, riding the standard
+    bitwise compare). Recovery/sparsity evidence is carried for the
+    tests' the-kill-really-fired and the-pulls-really-were-sparse
+    assertions — never part of the compare."""
+
+    ranks: np.ndarray
+    event_digest: np.ndarray
+    recoveries: int
+    sparse_pull_fraction: float
 
 
 @dataclasses.dataclass
@@ -189,6 +213,12 @@ def _leaves(workload: str, res) -> dict[str, np.ndarray]:
                 "rmse_history": np.asarray(res.rmse_history)}
     if workload == "pagerank_stream":
         return {"ranks": np.asarray(res.ranks)}
+    if workload == "rowstore":
+        # tda: ignore[TDA100] -- not a checkpoint payload: the
+        # bitwise-COMPARE surface; recoveries/sparsity stay outside it
+        # (see RowstoreChaosResult's docstring)
+        return {"ranks": np.asarray(res.ranks),
+                "event_digest": np.asarray(res.event_digest)}
     if workload == "serve":
         return {"replies": np.asarray(res.replies)}
     if workload == "cluster_serve":
@@ -243,6 +273,45 @@ def _make_runner(workload: str, mesh, n_iterations: int | None,
                     bytes.fromhex(event_digest(res)), np.uint8),
                 recoveries=int(res.get("coordinator_recoveries", 0)),
                 recovery_ms=list(res.get("recovery_ms", [])))
+        return run
+    if workload == "rowstore":
+        import os
+
+        from tpu_distalg import graphs
+        from tpu_distalg.cluster import rowstore
+
+        # the cache is built ONCE, outside both runs (the chaos
+        # surface is the fleet's pull/push/commit protocol, not the
+        # ingest) — small but genuinely sparse: each dst-window worker
+        # pulls a strict subset of the rank vector
+        path = os.path.join(workdir, "graph", "rowstore")
+        graphs.build_powerlaw_block_cache(
+            path, n_vertices=512, n_shards=4, avg_in_degree=8.0,
+            alpha=1.6, seed=3, block_edges=64)
+        iters = n_iterations or 6
+
+        def run(ckpt_dir):
+            # the plan drives the fleet CONFIG (point schedules
+            # compile plan-pure from it): the undisturbed reference
+            # runs registry-disabled -> no plan -> no fault
+            reg = faults.active()
+            plan_spec = reg.plan.spec() if reg is not None else None
+            res = rowstore.run_cluster_pagerank(
+                path, rowstore.ClusterPageRankConfig(
+                    n_iterations=iters, comm=comm,
+                    plan_spec=plan_spec,
+                    wal_dir=os.path.join(ckpt_dir, "wal")))
+            if res["version"] != iters:
+                raise RuntimeError(
+                    f"rowstore chaos run stopped at iteration "
+                    f"{res['version']}/{iters}")
+            return RowstoreChaosResult(
+                ranks=np.asarray(res["ranks"]),
+                event_digest=np.frombuffer(
+                    bytes.fromhex(res["event_digest"]), np.uint8),
+                recoveries=int(res["recoveries"]),
+                sparse_pull_fraction=float(
+                    res["sparse_pull_fraction"]))
         return run
     if workload == "lr":
         from tpu_distalg.models import logistic_regression as m

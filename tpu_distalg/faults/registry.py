@@ -44,6 +44,13 @@ Injection points (wired at the call sites named):
                     record bytes (replay's CRC truncates the tail
                     with a quarantine), ``oserror``/``hang`` model
                     transient disk faults
+  ``cluster:ps``    PS-shard crash schedule compilation
+                    (``cluster/rowstore.compile_point_schedule``) —
+                    one probe per window; ``kill`` = the shard dies at
+                    the merge seam AFTER the commit record is durable
+                    but BEFORE the merge applies (the WAL's REDO path:
+                    recovery re-applies the logged row deltas),
+                    ``hang`` = a slow shard merge
   ``cluster:replica``  the serving replica's per-score-frame seam
                     (``cluster/serve.py``) — ``kill`` = the replica
                     SIGKILLs itself mid-burst (thread mode slams its
@@ -143,6 +150,7 @@ POINTS = (
     "cluster:coordinator",
     "cluster:wal",
     "cluster:replica",
+    "cluster:ps",
 )
 
 KINDS = ("oserror", "hang", "corrupt", "kill", "straggle", "leave")
@@ -180,6 +188,12 @@ _POINT_KINDS = {
     # real SIGKILL mid-burst (thread mode slams the replica's sockets
     # so the router sees the same EOF), hang = a frozen replica
     "cluster:replica": ("kill", "hang"),
+    # the PS shard's merge seam (schedule-compiled, one probe per
+    # window): kill = the shard dies AFTER the commit record is
+    # durable but BEFORE the merge applies — the redo half of the WAL
+    # contract (the coordinator point covers the rollback half);
+    # hang = a slow shard the commit path rides out
+    "cluster:ps": ("kill", "hang"),
 }
 
 DEFAULT_HANG_SECONDS = 0.05

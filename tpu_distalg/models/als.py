@@ -358,3 +358,130 @@ def fit_streamed(dataset, config: ALSConfig | None = None, *,
     errs = jnp.stack(errs) if errs else jnp.zeros((0,))
     metrics.guard_finite(errs, "streamed ALS rmse history")
     return ALSResult(U=U[: config.m], V=V, rmse_history=errs)
+
+
+def fit_rowstore(config: ALSConfig = ALSConfig(), *,
+                 density: float = 0.08, ps_shards: int = 2,
+                 user_block: int = 32,
+                 model_budget_rows: int | None = None) -> dict:
+    """Observed-entry ALS with the item factor V living in the
+    SHARDED ROW STORE (``cluster/rowstore.py``, table ``als_train`` —
+    the same rule table the in-process trainer places V under): the
+    worker holds U and the ratings locally but NEVER materializes V
+    whole. Each user-block U-solve pulls only the V rows that block's
+    observed items reference, each V-update pushes per-row deltas
+    (one contribution at the store's own version → age 0, weight 1 —
+    an exact row replacement through the weighted-merge arithmetic),
+    and items nobody rated are never pulled, pushed, or versioned.
+
+    ``model_budget_rows`` is the >1-host-RAM contract: the peak V rows
+    any single pull materializes must stay under it or the fit RAISES
+    (the row store's streaming claim fails loudly, never silently
+    densifies). numpy-only — a host fleet worker, no mesh.
+
+    Returns ``{U, V, rmse_history, peak_pull_rows,
+    sparse_pull_fraction, rows_pulled, rows_pushed}`` where the
+    fraction is measured pulls over the dense pull-everything
+    baseline and V is a final snapshot (test/report surface, outside
+    the budget)."""
+    from tpu_distalg.cluster import rowstore as _rowstore
+
+    rng = np.random.default_rng(config.seed)
+    m, n, k, lam = config.m, config.n, config.k, config.lam
+    R = synthesize_rank_k(config)
+    observed = rng.random((m, n)) < density
+    user_cols = [np.flatnonzero(observed[i]) for i in range(m)]
+    item_users = [np.flatnonzero(observed[:, j]) for j in range(n)]
+    touched_items = np.flatnonzero(observed.any(axis=0))
+    n_obs = int(observed.sum())
+    if not n_obs:
+        raise ValueError("no observed entries at this density/seed")
+
+    store = _rowstore.RowStore(
+        {"V": rng.random((n, k), dtype=np.float32)},
+        table="als_train", n_shards=ps_shards)
+    U = rng.random((m, k), dtype=np.float32)
+
+    peak_pull = 0
+    rows_pulled = 0
+    rows_pushed = 0
+    n_pulls = 0
+
+    def pull(rows: np.ndarray) -> np.ndarray:
+        nonlocal peak_pull, rows_pulled, n_pulls
+        if model_budget_rows is not None \
+                and rows.shape[0] > model_budget_rows:
+            raise RuntimeError(
+                f"a pull needs {rows.shape[0]} V rows at once but the "
+                f"model budget is {model_budget_rows} — shrink the "
+                f"user blocks, not the honesty of the claim")
+        peak_pull = max(peak_pull, int(rows.shape[0]))
+        rows_pulled += int(rows.shape[0])
+        n_pulls += 1
+        vals, _vers = store.pull_rows("V", rows)
+        return vals
+
+    def solve(F: np.ndarray, r: np.ndarray) -> np.ndarray:
+        # (FᵀF + λ·|obs|·I) x = Fᵀ r — the reference's per-row normal
+        # equations, restricted to the OBSERVED entries
+        G = F.T @ F + lam * F.shape[0] * np.eye(k, dtype=np.float64)
+        return np.linalg.solve(G, F.T @ r)
+
+    errs = []
+    for _sweep in range(config.n_iterations):
+        # U half-sweep: per user block, pull the union of the block's
+        # observed item rows once
+        for b0 in range(0, m, user_block):
+            users = range(b0, min(b0 + user_block, m))
+            need = np.unique(np.concatenate(
+                [user_cols[i] for i in users
+                 if user_cols[i].size] or [np.empty(0, np.int64)]))
+            if not need.size:
+                continue
+            Vblk = pull(need).astype(np.float64)
+            for i in users:
+                cols = user_cols[i]
+                if not cols.size:
+                    continue
+                sel = np.searchsorted(need, cols)
+                U[i] = solve(Vblk[sel],
+                             R[i, cols].astype(np.float64)
+                             ).astype(np.float32)
+        # V half-sweep: per item block, solve the touched rows from
+        # local U and push the per-row deltas (pull old values first —
+        # the delta is the wire object, same as every rowstore push);
+        # blocked like the U pulls so the budget holds on BOTH halves
+        U64 = U.astype(np.float64)
+        sq_err = 0.0
+        item_blk = (min(user_block * 4, model_budget_rows)
+                    if model_budget_rows else user_block * 4)
+        for t0 in range(0, touched_items.shape[0], item_blk):
+            items = touched_items[t0:t0 + item_blk]
+            old = pull(items)
+            new = np.empty_like(old)
+            for t, j in enumerate(items):
+                users = item_users[j]
+                new[t] = solve(U64[users],
+                               R[users, j].astype(np.float64)
+                               ).astype(np.float32)
+            store.merge_rows(store.version, [
+                (0, {"V": (items, new - old, store.version)})])
+            rows_pushed += int(items.shape[0])
+            # observed-entry RMSE from the rows already in hand
+            mask = observed[:, items]
+            pred = np.einsum("ik,tk->it", U, new)[mask]
+            sq_err += np.sum((pred - R[:, items][mask]) ** 2)
+        errs.append(np.sqrt(sq_err / n_obs))
+
+    dense_rows = n_pulls * n
+    return {
+        "U": U,
+        "V": store.snapshot()["V"],
+        "rmse_history": np.asarray(errs, np.float32),
+        "peak_pull_rows": peak_pull,
+        "sparse_pull_fraction": (rows_pulled / dense_rows
+                                 if dense_rows else 0.0),
+        "rows_pulled": rows_pulled,
+        "rows_pushed": rows_pushed,
+        "row_versions": store.row_versions("V"),
+    }

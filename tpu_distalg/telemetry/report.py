@@ -370,6 +370,32 @@ def render(s: dict) -> str:
                 f"{c.get('cluster.pull_dense_fallbacks', 0)} dense "
                 f"fallback(s), {c.get('cluster.async_pushes', 0)} "
                 f"overlapped push(es))")
+        rs_pulled = s["counters"].get("rowstore.rows_pulled")
+        rs_pushed = s["counters"].get("rowstore.rows_pushed")
+        if rs_pulled or rs_pushed:
+            # sharded row store (cluster/rowstore.py): how sparse the
+            # row traffic actually was — rows pulled vs the dense
+            # row-pull baseline (every leaf whole, every pull), sparse
+            # wire bytes vs what dense snapshots would have shipped,
+            # the rpc retries the framed row wire absorbed, and the
+            # worst per-row staleness any merge gated on
+            c = s["counters"]
+            g = s["gauges"]
+            dense_rows = c.get("rowstore.pull_rows_dense", 0)
+            frac = ((rs_pulled or 0) / dense_rows) if dense_rows \
+                else 0.0
+            wire = (c.get("rowstore.wire_push_bytes", 0)
+                    + c.get("rowstore.wire_pull_bytes", 0))
+            lines.append(
+                f"rowstore: {rs_pulled or 0} row(s) pulled of "
+                f"{dense_rows} dense ({frac:.0%} sparse-pull "
+                f"fraction), {rs_pushed or 0} row(s) pushed, "
+                f"{wire / 1e6:.2f} MB sparse wire vs "
+                f"{c.get('rowstore.wire_dense_bytes', 0) / 1e6:.2f}"
+                f" MB dense, "
+                f"{c.get('rowstore.rpc_retries', 0)} rpc retr(ies), "
+                f"max row staleness "
+                f"{g.get('rowstore.max_row_staleness', 0)}")
         resh = s["counters"].get("reshard.syncs")
         if resh:
             # device-side resharding (parallel/partition.py): layout
