@@ -27,7 +27,16 @@ python -m tpu_distalg.cli lint tpu_distalg/ tests/ scripts/ bench.py \
     --baseline lint_baseline.json --format json --no-ruff \
     > /dev/null || rc=1
 
-# 3. README claims vs recorded bench artifacts
+# 3. the wire contract: docs/PROTOCOL.md must match what the
+#    protocol-graph extractor recovers from source (same docs-never-
+#    drift shape as the README reconciliation below)
+python -m tpu_distalg.cli protocol --check || rc=1
+
+# 4. the protocol extractor through --format json: engine-crash smoke
+#    on the machine-readable path, per the step-2 convention
+python -m tpu_distalg.cli protocol --format json > /dev/null || rc=1
+
+# 5. README claims vs recorded bench artifacts
 python scripts/check_readme_claims.py || rc=1
 
 if [ "$rc" -ne 0 ]; then

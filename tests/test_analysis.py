@@ -1732,9 +1732,13 @@ def test_tda102_unrendered_counter_flagged(tmp_path, monkeypatch):
                  "miniproj/report_mod.py": _report_mod(("x.y",))},
                 select=("TDA102",))
     msgs = [v.message for v in res.violations]
-    assert len(res.violations) == 2
+    assert len(res.violations) == 3
     assert any("'unseen.leak'" in m for m in msgs)
     assert any("percode." in m and "f-string family" in m
+               for m in msgs)
+    # the 'x.y' waiver covers nothing this surface emits — the
+    # stale-waiver direction reports it in the same pass
+    assert any("waiver 'x.y'" in m and "matches no emitted" in m
                for m in msgs)
 
 
@@ -1958,7 +1962,8 @@ def test_metric_contract_collector_matches_bench():
 
 def test_project_rules_have_codes_and_invariants():
     assert [r.code for r in analysis.PROJECT_RULES] == [
-        "TDA100", "TDA101", "TDA102", "TDA103"]
+        "TDA100", "TDA101", "TDA102", "TDA103",
+        "TDA110", "TDA111", "TDA112", "TDA113", "TDA114"]
     for rule in analysis.PROJECT_RULES:
         assert engine.CODE_RE.match(rule.code)
         assert rule.invariant and rule.name
@@ -2042,3 +2047,458 @@ def test_git_changed_is_cwd_relative_from_subdir(tmp_path,
     # git reports 'pkg/mod.py' (repo-root-relative); the lint file
     # list is cwd-relative, so the set must say 'mod.py'
     assert changed == {"mod.py"}
+
+
+# ------------------------------------------- TDA11x: the wire protocol
+
+TRANSPORT_STUB = """
+def send_frame(sock, kind, meta, arrays=()):
+    raise NotImplementedError
+
+
+def request(sock, kind, meta, arrays=()):
+    raise NotImplementedError
+
+
+def recv_frame(sock):
+    raise NotImplementedError
+"""
+
+
+def wire(tmp_path, monkeypatch, select, **mods):
+    """A miniproj with the transport stub plus the given modules,
+    linted with only ``select`` active."""
+    files = {"miniproj/__init__.py": "",
+             "miniproj/transport.py": TRANSPORT_STUB}
+    files.update({f"miniproj/{name}.py": src
+                  for name, src in mods.items()})
+    return plint(tmp_path, monkeypatch, files, select=select)
+
+
+PING_HANDLER = """
+def handle(kind, meta, arrays):
+    if kind == "ping":
+        return ("pong", {}, ())
+    return ("error", {"error": "unknown kind"}, ())
+"""
+
+#: kind-literal drift, reconstructed: the sender spells "pingg", the
+#: dispatch knows "ping" — the frame rots into the unknown-kind error
+#: fallthrough AND the branch goes dead, one finding per direction
+PINGG_SENDER = """
+from miniproj.transport import request
+
+
+def probe(sock):
+    k, m, a = request(sock, "pingg", {"slot": 0})
+    if k != "pong":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+PING_SENDER = """
+from miniproj.transport import request
+
+
+def probe(sock):
+    k, m, a = request(sock, "ping", {"slot": 0})
+    if k != "pong":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+
+def test_tda110_kind_drift_flagged_both_directions(tmp_path,
+                                                   monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA110",),
+               peer=PINGG_SENDER, serve=PING_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA110", "TDA110"]
+    by_path = {v.path: v.message for v in res.violations}
+    assert "'pingg'" in by_path["miniproj/peer.py"]
+    assert "no handler" in by_path["miniproj/peer.py"]
+    assert "'ping'" in by_path["miniproj/serve.py"]
+    assert "nothing on the lint surface sends" \
+        in by_path["miniproj/serve.py"]
+
+
+def test_tda110_matched_kinds_clean(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA110",),
+               peer=PING_SENDER, serve=PING_HANDLER)
+    assert res.violations == []
+
+
+def test_tda110_single_sided_surface_stays_silent(tmp_path,
+                                                  monkeypatch):
+    """A handler module linted without any requesting peer (or vice
+    versa) supports no bijectivity claim — the rule must stay
+    silent rather than flag every branch as dead."""
+    res = wire(tmp_path, monkeypatch, ("TDA110",),
+               serve=PING_HANDLER)
+    assert res.violations == []
+
+
+PUSH_HANDLER = """
+def handle(kind, meta, arrays):
+    if kind == "push":
+        window = meta["window"]
+        seq = meta.get("seq")
+        return ("ok", {"version": window}, ())
+    return ("error", {"error": "unknown kind"}, ())
+"""
+
+#: the dropped-key spelling: the handler indexes meta["window"], this
+#: encoder ships only the slot — a KeyError one process away
+PUSH_SENDER_NO_WINDOW = """
+from miniproj.transport import request
+
+
+def push(sock):
+    k, m, a = request(sock, "push", {"slot": 1})
+    if k != "ok":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+PUSH_SENDER_OK = """
+from miniproj.transport import request
+
+
+def push(sock, w):
+    ident = {"slot": 1, "inc": 3}
+    k, m, a = request(sock, "push", dict(ident, window=w))
+    if k != "ok":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+
+def test_tda111_missing_required_key_flagged(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA111",),
+               peer=PUSH_SENDER_NO_WINDOW, serve=PUSH_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA111"]
+    v = res.violations[0]
+    assert v.path == "miniproj/peer.py"
+    assert "window" in v.message and "'push'" in v.message
+
+
+def test_tda111_dataflow_resolved_keys_clean(tmp_path, monkeypatch):
+    """dict(ident, window=w) over a literal ident resolves through
+    the one-level dataflow; the handler's .get('seq') demands
+    nothing."""
+    res = wire(tmp_path, monkeypatch, ("TDA111",),
+               peer=PUSH_SENDER_OK, serve=PUSH_HANDLER)
+    assert res.violations == []
+
+
+PULL_HANDLER = """
+def handle(kind, meta, arrays):
+    if kind == "pull":
+        return ("chunk", {"seq": 0}, arrays)
+    return ("error", {"error": "unknown kind"}, ())
+"""
+
+#: reply-kind drift: the site waits for "chunks", a kind no handler
+#: of "pull" ever sends — the comparison can never come true
+PULL_SENDER_WRONG_REPLY = """
+from miniproj.transport import request
+
+
+def pull(sock):
+    k, m, a = request(sock, "pull", {"slot": 0})
+    if k == "error":
+        raise RuntimeError(m.get("error"))
+    if k == "chunks":
+        return a
+    return None
+"""
+
+#: the PR 13 pre-fix spelling, reconstructed: any unexpected reply —
+#: including a dying peer's ("error", ...) — reads as a genuine
+#: "nothing for you" and the caller keeps going on stale state
+PULL_SENDER_ADOPTS_ERROR = """
+from miniproj.transport import request
+
+
+def pull(sock):
+    k, m, a = request(sock, "pull", {"slot": 0})
+    if k == "chunk":
+        return a
+    return None
+"""
+
+PULL_SENDER_OK = """
+from miniproj.transport import request
+
+
+def pull(sock):
+    k, m, a = request(sock, "pull", {"slot": 0})
+    if k != "chunk":
+        raise RuntimeError(m.get("error"))
+    return a
+"""
+
+
+def test_tda112_impossible_reply_kind_flagged(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA112",),
+               peer=PULL_SENDER_WRONG_REPLY, serve=PULL_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA112"]
+    v = res.violations[0]
+    assert "'chunks'" in v.message and "no handler" in v.message
+
+
+def test_tda112_unchecked_error_reply_flagged(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA112",),
+               peer=PULL_SENDER_ADOPTS_ERROR, serve=PULL_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA112"]
+    v = res.violations[0]
+    assert "'error'" in v.message
+    assert "silently adopted" in v.message
+
+
+def test_tda112_catch_all_rejection_clean(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA112",),
+               peer=PULL_SENDER_OK, serve=PULL_HANDLER)
+    assert res.violations == []
+
+
+RESUME_HANDLER = """
+def _fence_stale(meta):
+    return int(meta.get("inc", -1)) < 0
+
+
+def handle(kind, meta, arrays):
+    if kind == "resume":
+        if _fence_stale(meta):
+            return ("error", {"error": "stale slot"}, ())
+        return ("ok", {}, ())
+    return ("error", {"error": "unknown kind"}, ())
+"""
+
+#: the token-less resume, reconstructed: the one frame the
+#: incarnation fencing cannot see — it either bounces as a zombie's
+#: or keeps a dead incarnation looking alive
+RESUME_SENDER_NO_INC = """
+from miniproj.transport import request
+
+
+def resume(sock):
+    k, m, a = request(sock, "resume", {"slot": 0})
+    if k != "ok":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+RESUME_SENDER_OK = """
+from miniproj.transport import request
+
+
+def resume(sock):
+    k, m, a = request(sock, "resume", {"slot": 0, "inc": 5})
+    if k != "ok":
+        raise RuntimeError(m.get("error"))
+    return m
+"""
+
+
+def test_tda113_tokenless_fenced_frame_flagged(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA113",),
+               peer=RESUME_SENDER_NO_INC, serve=RESUME_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA113"]
+    v = res.violations[0]
+    assert v.path == "miniproj/peer.py"
+    assert "'inc' token" in v.message and "'resume'" in v.message
+
+
+def test_tda113_token_carried_clean(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA113",),
+               peer=RESUME_SENDER_OK, serve=RESUME_HANDLER)
+    assert res.violations == []
+
+
+#: ack-before-append, reconstructed: the peer observes an "ok" a
+#: crashed recovery would forget it ever sent
+ACK_FIRST_HANDLER = """
+from miniproj.transport import send_frame
+
+
+class Ledger:
+    def handle(self, kind, meta, arrays):
+        if kind == "commit":
+            send_frame(self.conn, "ok", {})
+            self.wal.append("commit", meta)
+        return None
+"""
+
+APPEND_FIRST_HANDLER = """
+from miniproj.transport import send_frame
+
+
+class Ledger:
+    def handle(self, kind, meta, arrays):
+        if kind == "commit":
+            self.wal.append("commit", meta)
+            send_frame(self.conn, "ok", {})
+        return None
+"""
+
+
+def test_tda114_ack_before_append_flagged(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA114",),
+               serve=ACK_FIRST_HANDLER)
+    assert [v.code for v in res.violations] == ["TDA114"]
+    v = res.violations[0]
+    assert "'ok'" in v.message and "'commit'" in v.message
+
+
+def test_tda114_append_then_ack_clean(tmp_path, monkeypatch):
+    res = wire(tmp_path, monkeypatch, ("TDA114",),
+               serve=APPEND_FIRST_HANDLER)
+    assert res.violations == []
+
+
+# -------------------------------------------------- `tda protocol`
+
+
+def test_protocol_check_matches_committed_doc(monkeypatch, capsys):
+    """TIER-1 gate: docs/PROTOCOL.md IS the extracted contract — the
+    same check scripts/lint_gate.sh runs."""
+    from tpu_distalg import cli
+
+    monkeypatch.delenv("TDA_TELEMETRY_DIR", raising=False)
+    monkeypatch.chdir(REPO)
+    assert cli.main(["protocol", "--check"]) == 0
+
+
+def test_protocol_json_renders_the_cluster_contract(monkeypatch,
+                                                    capsys):
+    from tpu_distalg import cli
+
+    monkeypatch.delenv("TDA_TELEMETRY_DIR", raising=False)
+    monkeypatch.chdir(REPO)
+    assert cli.main(["protocol", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"frames", "frame_sites", "wal_records",
+                        "synthetics", "n_dynamic_sends"}
+    kinds = {row["kind"] for row in doc["frames"]}
+    assert {"join", "push", "pull", "poll", "beat", "bye"} <= kinds
+    fenced = {row["kind"] for row in doc["frames"] if row["fenced"]}
+    assert "push" in fenced and "skip" in fenced
+    assert "reset" in doc["synthetics"]   # the link's local synthetic
+
+
+# ------------------------------------------ lint surface invariants
+
+
+def test_cli_json_schema_is_pinned(tmp_path, monkeypatch, capsys):
+    """The --format json document is parsed by scripts/lint_gate.sh
+    and editor tooling: its top-level keys and per-finding fields
+    (suppression findings ride the same shape) are pinned here so
+    schema drift is a deliberate edit, not an accident."""
+    from tpu_distalg import cli
+
+    monkeypatch.delenv("TDA_TELEMETRY_DIR", raising=False)
+    pkg = tmp_path / "tpu_distalg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(VIOLATING)
+    (pkg / "pinned.py").write_text(
+        "# tda: ignore[TDA002] -- stale pin, nothing underneath\n"
+        "X = 1\n")
+    assert cli.main(["lint", str(pkg), "--no-ruff",
+                     "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"files", "linted", "cached", "graph_seconds",
+                        "violations", "baselined", "stale_baseline",
+                        "ruff_rc", "ruff_output"}
+    assert doc["files"] == 2 and doc["linted"] == 2
+    assert {v["code"] for v in doc["violations"]} \
+        == {"TDA000", "TDA001"}   # a finding + a suppression record
+    for v in doc["violations"]:
+        assert set(v) == {"code", "message", "path", "line", "col",
+                          "snippet", "fingerprint"}
+    assert isinstance(doc["graph_seconds"], float)
+    assert doc["baselined"] == 0 and doc["stale_baseline"] == []
+
+
+def test_lint_graph_seconds_stays_interactive(tmp_path):
+    """TIER-1 perf tripwire: the protocol extraction rides every
+    summary build, so the graph pass must stay cheap — a cold full
+    tree under 10 s, a warm --changed-style run under 2 s."""
+    paths = [str(REPO / "tpu_distalg"), str(REPO / "tests"),
+             str(REPO / "scripts"), str(REPO / "bench.py")]
+    files = engine.iter_python_files(paths)
+    cache = str(tmp_path / "graphcache")
+    cold = projmod.lint_tree(files, analysis.RULES,
+                             analysis.PROJECT_RULES, cache_dir=cache)
+    assert cold.graph_seconds < 10.0, (
+        f"cold graph build took {cold.graph_seconds}s")
+    warm = projmod.lint_tree(
+        files, analysis.RULES, analysis.PROJECT_RULES,
+        changed_only={engine.norm_path(files[0])}, cache_dir=cache)
+    assert warm.n_cached >= len(files) - 1
+    assert warm.graph_seconds < 2.0, (
+        f"warm --changed graph pass took {warm.graph_seconds}s")
+
+
+# --------------------------------------- TDA102: stale-waiver audit
+
+#: one entry per line — the committed report.py style the --fix path
+#: assumes (it deletes the entry's line plus its riding comments)
+STALE_WAIVER_REPORT = """
+SUMMARY_ONLY_COUNTERS = (
+    "unseen.leak",
+    "percode.*",
+    "ghost.metric",
+    # the summary line it used to feed, retired three PRs ago
+)
+PER_WORKER_PREFIXES = ("col.",)
+
+
+def render(s):
+    return "requests: " + str(s.get("seen.requests"))
+"""
+
+
+def test_tda102_stale_waiver_flagged(tmp_path, monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/tel.py": TELMOD,
+                 "miniproj/emitter.py": EMITTER,
+                 "miniproj/report_mod.py": _report_mod(
+                     ("unseen.leak", "percode.*", "ghost.metric"))},
+                select=("TDA102",))
+    assert [v.code for v in res.violations] == ["TDA102"]
+    v = res.violations[0]
+    assert v.path == "miniproj/report_mod.py"
+    assert "'ghost.metric'" in v.message
+    assert "matches no emitted" in v.message
+
+
+def test_tda102_waiver_audit_needs_an_emitting_surface(tmp_path,
+                                                       monkeypatch):
+    """A lone report-module lint sees no emissions at all: every
+    waiver would read as stale — the audit must stay silent."""
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/tel.py": TELMOD,
+                 "miniproj/report_mod.py": _report_mod(
+                     ("ghost.metric",))},
+                select=("TDA102",))
+    assert res.violations == []
+
+
+def test_tda102_stale_waiver_fix_removes_entry_line(tmp_path,
+                                                    monkeypatch):
+    res = plint(tmp_path, monkeypatch,
+                {"miniproj/__init__.py": "",
+                 "miniproj/tel.py": TELMOD,
+                 "miniproj/emitter.py": EMITTER,
+                 "miniproj/report_mod.py": STALE_WAIVER_REPORT},
+                select=("TDA102",))
+    assert [v.code for v in res.violations] == ["TDA102"]
+    src = textwrap.dedent(STALE_WAIVER_REPORT)
+    fixed, n = fixes.fix_source(src, res.violations)
+    assert n == 2              # the entry line + the comment under it
+    assert "ghost.metric" not in fixed
+    assert "retired three PRs ago" not in fixed
+    assert '"unseen.leak",' in fixed and '"percode.*",' in fixed
+    assert "def render" in fixed

@@ -607,6 +607,38 @@ def test_cluster_zombie_incarnation_is_fenced():
         coord.stop()
 
 
+def test_worker_rpc_raises_on_error_reply(monkeypatch):
+    """A fenced-out (or dying) coordinator answers a poll with
+    ("error", ...). The pre-fix rpc adopted that frame as data — no
+    version/done/restart key, so the admission gate spun on stale
+    state until its deadline (a zombie training silently, the TDA112
+    class). The fix surfaces it as a link failure the supervised
+    path can rejoin from."""
+    orig = worker._Link.request
+
+    def poison(self, kind, meta, arrays=None, **kw):
+        if kind == "poll":
+            return "error", {"error": "stale slot"}, {}
+        return orig(self, kind, meta, arrays, **kw)
+
+    monkeypatch.setattr(worker._Link, "request", poison)
+    # bound the PRE-fix failure mode: without the raise the gate
+    # would spin until this deadline, not hang the suite for 300 s
+    monkeypatch.setattr(worker, "GATE_DEADLINE_SECONDS", 5.0)
+    cfg = clus.ClusterConfig(**{**CFG, "n_slots": 1, "n_windows": 4,
+                                "heartbeat_timeout": 30.0})
+    coord = clus.Coordinator(cfg).start()
+    try:
+        # admit_at=2 > version=0 routes the worker straight into the
+        # admission gate, whose first round trip is rpc("poll", ...)
+        with pytest.raises(transport.TransportClosed,
+                           match="poll rejected: stale slot"):
+            worker.run_worker("127.0.0.1", coord.port, slot=0,
+                              admit_at=2)
+    finally:
+        coord.stop()
+
+
 def test_cluster_rejects_bsp_and_bad_policy():
     with pytest.raises(ValueError, match="policy"):
         clus.ClusterConfig(policy="bsp")

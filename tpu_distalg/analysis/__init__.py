@@ -70,7 +70,29 @@ TDA103      cross-module lock discipline: an attribute written from
             thread entries in different modules needs ONE common
             lock, not one lock per module (the gap TDA020's
             single-file view cannot see)
+TDA110      wire-contract bijectivity: every frame kind some peer
+            sends has a dispatch branch somewhere, and every dispatch
+            branch matches a kind something sends (dead kinds rot
+            into silent drops)
+TDA111      payload-key contract: a meta key any decoder of kind K
+            reads without a default is written by EVERY resolvable
+            encoder of K (the cross-process latent-KeyError class)
+TDA112      request/reply pairing: a round trip's accepted reply
+            kinds are kinds some handler of K actually sends, and an
+            ``error``-kind reply is explicitly handled (the PR 13
+            "dying coordinator answers" class)
+TDA113      incarnation-fencing completeness: every resolvable
+            encoder of a fenced frame kind populates the ``inc``
+            token (the PR 13 round-2 zombie class)
+TDA114      WAL-before-ack at protocol scope: in any handler that
+            both appends a record and sends a frame, the append
+            dominates the send on every branch path (TDA091
+            generalized beyond fsync syntax)
 ==========  =========================================================
+
+The TDA11x rows run over the protocol graph — the wire-contract slice
+of the same project graph; ``tda protocol`` renders that contract as
+a table and ``--check`` pins it against ``docs/PROTOCOL.md``.
 
 Suppress a finding with ``# tda: ignore[TDA0xx] -- reason`` (the reason
 is mandatory); grandfather existing debt with ``lint_baseline.json``.
@@ -104,6 +126,7 @@ from tpu_distalg.analysis.project import (
     build_project,
     lint_tree,
 )
+from tpu_distalg.analysis.protocol import RULES as _PROTOCOL
 from tpu_distalg.analysis.seams import RULES as _SEAMS
 from tpu_distalg.analysis.serve import RULES as _SERVE
 from tpu_distalg.analysis.ssp import RULES as _SSP
@@ -120,7 +143,7 @@ RULES = tuple(sorted(
 
 #: the interprocedural family — runs once over the project graph
 PROJECT_RULES = tuple(sorted(
-    _CARRY + _HANDOFF + _TELEMETRY_CONTRACT + _CROSSLOCK,
+    _CARRY + _HANDOFF + _TELEMETRY_CONTRACT + _CROSSLOCK + _PROTOCOL,
     key=lambda r: r.code))
 
 __all__ = [

@@ -68,6 +68,90 @@ def add_parser_args(p):
                         "installed")
 
 
+def add_protocol_args(p):
+    p.add_argument("paths", nargs="*", metavar="PATH",
+                   help="files/directories to extract the wire "
+                        "contract from (default: the lint surface)")
+    p.add_argument("--format", default="text",
+                   choices=["text", "json", "md"],
+                   help="text (aligned table), json (for CI), or md "
+                        "(the docs/PROTOCOL.md spelling)")
+    p.add_argument("--check", nargs="?", const="docs/PROTOCOL.md",
+                   default=None, metavar="FILE",
+                   help="diff the extracted contract against the "
+                        "committed markdown (default "
+                        "docs/PROTOCOL.md); exit 1 on drift")
+
+
+def run_protocol(args) -> int:
+    """``tda protocol`` — render the extracted wire contract, or
+    ``--check`` it against the committed ``docs/PROTOCOL.md`` (same
+    docs-can-never-drift shape as ``check_readme_claims.py``)."""
+    from tpu_distalg.analysis import protocol as protomod
+
+    paths = list(args.paths) or [p for p in DEFAULT_PATHS
+                                 if os.path.exists(p)]
+    if not paths:
+        print("tda protocol: no paths given and none of "
+              f"{'/'.join(DEFAULT_PATHS)} exist here", file=sys.stderr)
+        return 2
+    try:
+        files = engine.iter_python_files(paths)
+        with tevents.span("protocol", files=len(files)):
+            proj, _ = projmod.build_project(files,
+                                            cache_dir=CACHE_DIR)
+            contract = protomod.build_contract(proj)
+            tevents.gauge("protocol.frame_kinds",
+                          len(contract["frames"]))
+            if args.check is not None:
+                return _check_protocol_doc(args.check, contract)
+            if args.format == "json":
+                print(json.dumps(protomod.render_json(contract),
+                                 indent=1))
+            elif args.format == "md":
+                print(protomod.render_md(contract))
+            else:
+                print(protomod.render_text(contract))
+        return 0
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tda protocol: {e}", file=sys.stderr)
+        return 2
+
+
+def _check_protocol_doc(doc_path: str, contract) -> int:
+    from tpu_distalg.analysis import protocol as protomod
+
+    want = protomod.render_md(contract)
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            have = f.read()
+    except OSError as e:
+        print(f"FAIL {doc_path}: unreadable ({e}); regenerate with "
+              f"`python -m tpu_distalg.cli protocol --format md > "
+              f"{doc_path}`")
+        return 1
+    if have.strip() == want.strip():
+        print(f"ok: {doc_path} matches the extracted wire contract")
+        return 0
+    want_l, have_l = want.strip().splitlines(), have.strip().splitlines()
+    n_shown = 0
+    for i in range(max(len(want_l), len(have_l))):
+        w = want_l[i] if i < len(want_l) else "<missing>"
+        h = have_l[i] if i < len(have_l) else "<missing>"
+        if w != h:
+            print(f"FAIL {doc_path}:{i + 1}:")
+            print(f"  committed: {h}")
+            print(f"  extracted: {w}")
+            n_shown += 1
+            if n_shown >= 10:
+                print("  ... (further drift elided)")
+                break
+    print(f"FAIL {doc_path} drifted from the code; regenerate with "
+          f"`python -m tpu_distalg.cli protocol --format md > "
+          f"{doc_path}`")
+    return 1
+
+
 def _codes(arg: str | None):
     if arg is None:
         return None

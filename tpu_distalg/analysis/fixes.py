@@ -16,6 +16,10 @@ applied:
     remove the comment (the whole line when it stood alone, just the
     trailing comment otherwise). Nothing was being suppressed, so the
     removal cannot surface new findings.
+  * TDA102 (stale waiver): a ``SUMMARY_ONLY_COUNTERS`` entry matching
+    zero emitted counters is the waiver-table spelling of an unused
+    suppression — delete the entry's line (the table keeps one entry
+    per line). It waived nothing, so nothing new can fire.
 
 Everything else (hoisting a host sync, adding a lock, routing a write
 through a seam) changes semantics and stays a human's job.
@@ -31,6 +35,7 @@ from tpu_distalg.analysis.concurrency import _is_thread_call
 _IGNORE_BARE_RE = re.compile(r"(tda:\s*ignore\[[A-Z0-9,\s]+\])\s*$")
 _IGNORE_COMMENT_RE = re.compile(
     r"\s*#\s*tda:\s*ignore\[[A-Z0-9,\s]*\].*$")
+_STALE_WAIVER_RE = re.compile(r"waiver '([^']+)' in \w+ matches no")
 
 TODO_REASON = "TODO: justify this suppression"
 
@@ -124,6 +129,31 @@ def fix_source(source: str, violations) -> tuple[str, int]:
                         and lines[j].startswith(indent + "#"):
                     edits.append((j, lambda s: ""))
                     j += 1
+
+    for v in violations:
+        m = _STALE_WAIVER_RE.search(v.message) \
+            if v.code == "TDA102" else None
+        if m is None:
+            continue
+        entry = m.group(1)
+        # v.line anchors at the waiver TUPLE's assignment; the entry
+        # itself sits on its own line below (the table's committed
+        # style). Scan to the tuple's close for the quoted entry and
+        # drop that line, plus any continuation comment lines riding
+        # under it.
+        for j in range(v.line - 1, min(v.line + 200, len(lines))):
+            text = lines[j]
+            if f'"{entry}"' not in text and f"'{entry}'" not in text:
+                if j > v.line - 1 and text.strip().startswith(")"):
+                    break
+                continue
+            edits.append((j, lambda s: ""))
+            k = j + 1
+            while k < len(lines) \
+                    and lines[k].lstrip().startswith("#"):
+                edits.append((k, lambda s: ""))
+                k += 1
+            break
 
     n = 0
     for idx, fn in sorted(edits, key=lambda e: -e[0]):

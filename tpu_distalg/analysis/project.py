@@ -35,8 +35,9 @@ from tpu_distalg.analysis import engine
 
 #: bump when extract_summary's output shape OR semantics change —
 #: stale cache entries from an older extractor must re-extract, not
-#: half-parse (2: package-anchored module names)
-EXTRACT_VERSION = 2
+#: half-parse (2: package-anchored module names; 3: wire-protocol
+#: facts for the TDA11x family)
+EXTRACT_VERSION = 3
 
 CACHE_NAME = "lint_graph.json"
 
@@ -477,6 +478,10 @@ def summarize_context(ctx: "engine.LintContext") -> dict:
     report_strings = sorted({s for s in _str_consts(tree)
                              if len(s) <= 80}) if report_like else []
 
+    # late import: protocol.py builds ON the project graph (ProjectRule
+    # base, _walk_functions) while its extractor feeds the summaries
+    from tpu_distalg.analysis import protocol as _protocol
+
     return {
         "version": EXTRACT_VERSION,
         "path": ctx.path,
@@ -497,6 +502,7 @@ def summarize_context(ctx: "engine.LintContext") -> dict:
         "thread_writes": thread_writes,
         "report_like": report_like,
         "report_strings": report_strings,
+        "protocol": _protocol.extract_protocol(tree, imports),
         "suppressions": [
             # tda: ignore[TDA100] -- `used` is per-run matching state
             # (which findings a pin absorbed THIS run), not part of

@@ -181,11 +181,17 @@ class TelemetryContract(ProjectRule):
         families += [w[:-1] for w in waivers if w.endswith("*")]
         report_paths = {r["path"] for r in reports}
         seen: set = set()
+        emitted_names: set = set()
+        emitted_prefixes: set = set()
         for s in project.library():
             if s["path"] in report_paths:
                 continue
             for emit in s["counter_emits"]:
                 name, prefix = emit["name"], emit["prefix"]
+                if name is not None:
+                    emitted_names.add(name)
+                elif prefix:
+                    emitted_prefixes.add(prefix)
                 key = (s["path"], name or prefix, emit["line"])
                 if key in seen:
                     continue
@@ -208,6 +214,37 @@ class TelemetryContract(ProjectRule):
                     f"line, or list it in {WAIVER_TUPLE} "
                     f"('name' or 'family.*') to state that the "
                     f"generic counters rendering is enough")
+        # the reverse direction — waiver rot. An entry matching zero
+        # emissions is a retired counter's ghost: it reads as "this
+        # signal is accounted for" while waiving nothing, exactly the
+        # drift the unused-suppression detector stops for inline pins.
+        # Only decidable when the surface actually emits (a lone
+        # report-module lint sees no emissions and must stay silent).
+        if not emitted_names and not emitted_prefixes:
+            return
+        for r in reports:
+            decl = r["str_tuples"].get(WAIVER_TUPLE)
+            if decl is None:
+                continue
+            for entry in decl["values"]:
+                if entry.endswith("*"):
+                    fam = entry[:-1]
+                    used = any(n.startswith(fam)
+                               for n in emitted_names) \
+                        or any(fam.startswith(p) or p.startswith(fam)
+                               for p in emitted_prefixes)
+                else:
+                    used = entry in emitted_names \
+                        or any(entry.startswith(p)
+                               for p in emitted_prefixes)
+                if used:
+                    continue
+                yield self.project_violation(
+                    project, r["path"], decl["line"],
+                    f"waiver '{entry}' in {WAIVER_TUPLE} matches no "
+                    f"emitted counter/gauge on this surface — a "
+                    f"retired signal's ghost; remove the entry (or "
+                    f"restore the emission it claims to waive)")
 
     def _check_metrics(self, project):
         # ONE implementation of the drift checks: rebuild the

@@ -663,6 +663,18 @@ def main(argv=None):
                    help="record the lint run as telemetry events "
                         "(a 'lint' span + per-rule counters)")
 
+    p = sub.add_parser(
+        "protocol",
+        help="extract the cluster wire contract from source (frame "
+             "kinds, payload keys, reply pairings, fencing, WAL "
+             "records) as a deterministic table; --check pins "
+             "docs/PROTOCOL.md against it")
+    lint_cli.add_protocol_args(p)
+    p.add_argument("--telemetry-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="record the extraction as telemetry events "
+                        "(a 'protocol' span)")
+
     p = sub.add_parser("report",
                        help="summarize a telemetry event log: phase "
                             "durations, stalls, backend-init attempts, "
@@ -688,6 +700,14 @@ def main(argv=None):
 
         telemetry.configure(args.telemetry_dir)
         return lint_cli.run_lint(args)
+
+    if args.cmd == "protocol":
+        # pure source analysis — no backend, no mesh, no jax import
+        from tpu_distalg import telemetry
+        from tpu_distalg.analysis import cli as lint_cli
+
+        telemetry.configure(args.telemetry_dir)
+        return lint_cli.run_protocol(args)
 
     if args.cmd == "report":
         # pure log analysis — no backend, no mesh, no jax import

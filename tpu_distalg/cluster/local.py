@@ -539,6 +539,10 @@ def _tcp_status(host, port, *, deadline: float = 2.0):
     except transport.TransportError:
         return None
     try:
+        # tda: ignore[TDA112] -- launcher-side liveness probe: a dead
+        # coordinator surfaces as TransportError from request itself,
+        # and the caller treats any reply shape as "alive" (the meta
+        # fields all default); there is no fencing to misread here
         _, m, _ = transport.request(sock, "poll", {},
                                     deadline=deadline)
         return m
@@ -557,6 +561,10 @@ def _tcp_hold(host, port, window, n_active, *,
     spelling of ``Coordinator.hold_admission``)."""
     sock = transport.connect(host, port, deadline=deadline)
     try:
+        # tda: ignore[TDA112] -- best-effort admission hint: the
+        # launcher proceeds identically whether the hold lands or
+        # errors (the rejoiner's admit_at pins the schedule either
+        # way), so the reply is deliberately unexamined
         transport.request(sock, "hold",
                           {"window": window, "n_active": n_active},
                           deadline=deadline)

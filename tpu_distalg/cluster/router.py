@@ -787,6 +787,10 @@ class Router:
                     self._pull_codec, delta, None,
                     pcomms.PULL_SEED_TAG, link.rid, int(have),
                     version)
+                # tda: ignore[TDA112] -- the delta swap is
+                # opportunistic: ANY non-swap_ok reply (swap_stale,
+                # error) falls through to the dense swap below, which
+                # checks its reply strictly
                 kind, meta, _ = transport.request(
                     link._ctrl_sock, "swap",
                     {"mode": "delta", "cv": version,
@@ -797,6 +801,10 @@ class Router:
                     return "delta"
                 # swap_stale: replica's base moved under us — fall
                 # through to the dense snapshot
+            # tda: ignore[TDA111] -- 'base' is read only on the DELTA
+            # branch of the swap handler; the dense spelling ships
+            # the full center and the handler never touches
+            # meta["base"] for mode=dense
             kind, meta, _ = transport.request(
                 link._ctrl_sock, "swap",
                 {"mode": "dense", "cv": version}, center,
@@ -950,6 +958,9 @@ class RouterClient:
     def close(self) -> None:
         try:
             with self._lock:
+                # tda: ignore[TDA112] -- best-effort farewell on
+                # close: the client is gone either way; an error
+                # reply must not turn close() into a raise
                 transport.request(self._sock, "stop",
                                   deadline=self._deadline)
         except (transport.TransportError, OSError):

@@ -279,6 +279,12 @@ class _HbLink:
                         self.sock = self.connect(
                             self.host, self.port, attempts=2,
                             retry_sleep=0.05)
+                    # tda: ignore[TDA112] -- the beat is a pure
+                    # liveness signal on its own link; the reply is
+                    # drained only to keep the socket frame-aligned,
+                    # and a stale-slot error must not kill the beat
+                    # thread — the MAIN link surfaces fencing on the
+                    # next rpc
                     transport.send_frame(self.sock, "beat",
                                          dict(self.ident),
                                          deadline=self.deadline)
@@ -338,6 +344,9 @@ class _PendingPush:
         def _send():
             t0 = time.monotonic()
             try:
+                # tda: ignore[TDA112] -- the async push's reply is
+                # consumed by harvest(), which raises on an error
+                # reply; this sender closure only parks it
                 reply = link.request("push", meta, arrays,
                                      deadline=deadline)
                 with self._lock:
@@ -562,6 +571,10 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
         try:
             if sock is None:
                 sock = connect(host, port)
+            # tda: ignore[TDA112] -- the join loop breaks only on
+            # welcome; every non-welcome fall-through below retries
+            # or raises "join rejected" with the error payload — the
+            # error reply IS the handled rejection path
             kind, meta, center = transport.request(
                 sock, "join",
                 {"slot": slot, "rejoin": rejoin,
@@ -779,6 +792,13 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
         if k == "reset":
             adopt_reset(m, arrs)
             return k, m, arrs
+        if k == "error":
+            # a fenced-out slot's poll/skip gets ("error", "stale
+            # slot") back — adopting it as data keeps a zombie
+            # training silently; surface it like any other link
+            # failure so the supervised path rejoins
+            raise transport.TransportClosed(
+                f"{kind} rejected: {m.get('error', 'unknown')}")
         version = int(m.get("version", version))
         done = bool(m.get("done", done))
         restart = bool(m.get("restart", restart))
@@ -949,6 +969,9 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
                 round(float(np.percentile(rtts, 50)), 3)
                 if rtts else 0.0)
             try:
+                # tda: ignore[TDA112] -- fire-and-forget farewell:
+                # an error from a dying coordinator changes nothing
+                # about a worker that is already leaving
                 link.request("bye", dict(ident, stats=stats),
                              retries=1)
             except transport.TransportError:

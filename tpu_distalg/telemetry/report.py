@@ -450,6 +450,7 @@ SUMMARY_ONLY_COUNTERS = (
     "graph.edges_streamed",
     "lint.*",                   # per-code counts + files/cached/
     #                             graph_seconds; the span carries time
+    "protocol.frame_kinds",     # contract size; the span carries time
     "serve.artifact_reread",
     "serve.failed_batches",
     "serve.merge_bytes_wire",
