@@ -78,6 +78,7 @@ accuracy is emitted in the SSGD JSON line (reference golden 0.929825,
 
 import json
 import os
+import socket
 import sys
 import threading
 import time
@@ -147,6 +148,11 @@ _T0 = time.monotonic()   # bench start — the hard-deadline budget clock
 # masquerade as a TPU round (bench_artifacts skips cpu-tagged artifacts
 # when resolving the claims/tripwire reference)
 _BACKEND_TAG = None
+# the RigProfile driving this round's tuned A/B phases (set by
+# ensure_profile / _rig_profile); the summary line carries it — or
+# "untuned" — so bench_artifacts can refuse to reconcile claims
+# against a profile measured on a different rig
+_TUNE_PROFILE_ID = None
 
 
 def _emit(obj):
@@ -230,6 +236,8 @@ def _emit_summary():
             "unit": head["unit"],
             "vs_baseline": head["vs_baseline"],
             **({"backend": _BACKEND_TAG} if _BACKEND_TAG else {}),
+            "rig": socket.gethostname(),
+            "tune_profile": _TUNE_PROFILE_ID or "untuned",
             "all_metrics": {k: v["value"] for k, v in _SUMMARY.items()},
             "all_units": {k: v["unit"] for k, v in _SUMMARY.items()},
             "all_vs_baseline": {k: v["vs_baseline"]
@@ -307,14 +315,31 @@ def _emit_deadline_summary():
         _emit_summary()
 
 
-def _init_retry_budget(remaining_seconds):
+def _init_attempt_timeout(init_seconds=None):
+    """Per-attempt backend-init deadline: the hardcoded worst-case cap,
+    SHRUNK to 3x the rig's MEASURED init time when the RigProfile
+    carries one (``tda tune`` records ``backend_init_s``) — a backend
+    whose healthy init takes 8 s should be declared hung after ~24 s,
+    not after the 5-minute cap sized for a cold tunneled TPU (r05's
+    26-minute retry tail was this cap times a handful of attempts)."""
+    if not isinstance(init_seconds, (int, float)) or init_seconds <= 0:
+        return INIT_TIMEOUT_SECONDS
+    return min(INIT_TIMEOUT_SECONDS, max(10.0, 3.0 * init_seconds))
+
+
+def _init_retry_budget(remaining_seconds, init_seconds=None):
     """Backend-init RETRIES whose total attempt count (retries + the
     first attempt) fits half the remaining hard-deadline budget (r5
     regression: 40 fixed attempts x ~6 min = 4 h of retrying inside a
     3 h window — the driver's SIGKILL landed while init was still
     spinning and the artifact parsed null); the other half stays
-    reserved for the bench proper."""
-    per_attempt = INIT_TIMEOUT_SECONDS + INIT_RETRY_SECONDS
+    reserved for the bench proper. ``init_seconds`` (the profile's
+    measured backend-init time) re-prices each attempt via
+    :func:`_init_attempt_timeout`, so a fast-init rig gets MORE
+    retries inside the same budget instead of burning it on the
+    worst-case cap."""
+    per_attempt = _init_attempt_timeout(init_seconds) \
+        + INIT_RETRY_SECONDS
     attempts = int((remaining_seconds * 0.5) // per_attempt)
     return max(0, min(INIT_RETRY_ATTEMPTS - 1, attempts - 1))
 
@@ -620,6 +645,249 @@ def _bench_comm_speedup(mesh, n_chips):
     """The measured step-time phase — see
     :func:`run_comm_step_speedup`."""
     run_comm_step_speedup(mesh, _emit)
+
+
+def _rig_profile():
+    """The newest valid RigProfile tagged with THIS rig's hostname, or
+    None — read-only (never measures): the init-retry pricing must not
+    spend seconds profiling before the backend is even up. The tuned
+    A/B phases use :func:`ensure_profile`, which measures on a miss."""
+    global _TUNE_PROFILE_ID
+    from tpu_distalg import tune as ttune
+
+    try:
+        prof, _path = ttune.newest_profile(rig=socket.gethostname())
+    except Exception:  # noqa: BLE001 — a bad profile dir never blocks init
+        return None
+    if prof is not None:
+        _TUNE_PROFILE_ID = prof["profile_id"]
+    return prof
+
+
+def ensure_profile(*, backend="cpu", quick=True):
+    """The newest rig-matching RigProfile — measured fresh (quick
+    pass, no backend-init subprocess) when this rig has none, so the
+    tuned A/B phases never resolve geometry from another machine's
+    numbers. A freshly measured profile is published to the default
+    profile dir (best-effort) so ``--tune auto`` and later rounds
+    reuse it."""
+    global _TUNE_PROFILE_ID
+    from tpu_distalg import tune as ttune
+
+    prof, _path = ttune.newest_profile(rig=socket.gethostname())
+    if prof is None:
+        meas = ttune.measure_rig(seed=0, quick=quick,
+                                 include_backend_init=False)
+        prof = ttune.build_profile(meas, created_unix=time.time(),
+                                   seed=0, backend=backend)
+        try:
+            ttune.save_profile(prof)
+        except OSError:
+            pass  # read-only checkout: the in-memory profile still drives
+    _TUNE_PROFILE_ID = prof["profile_id"]
+    return prof
+
+
+def run_tuned_step_speedup(mesh, emit, *, profile=None,
+                           d=COMM_SPEEDUP_D,
+                           rows_per_shard=COMM_SPEEDUP_ROWS_PER_SHARD,
+                           steps=30, repeats=3):
+    """MEASURED step-time of the cost-model-resolved comm geometry vs
+    the default table (``tuned_step_speedup`` = tuned steps/s ÷
+    default steps/s): the autotuner's end-to-end claim, at the same
+    comm-bound SSGD geometry as :func:`run_comm_step_speedup`.
+
+    Honesty rules, both directions: when the resolver CHOOSES the
+    default schedule (on a single-host rig the device "wire" is shared
+    memory — nothing to compress, so the resolver keeps dense), both
+    arms would time the SAME compiled program, so the ratio is emitted
+    as exactly 1.0 with ``identical_geometry: true`` instead of
+    publishing two noise samples of one program as a "speedup"; and
+    when the arms DO differ, a measured ratio below 1.0 RAISES (the
+    resolver mispredicted on this rig — a recorded phase error the
+    cost model must answer for, never a fabricated floor-claim
+    number). The default arm's measured step time is recorded as the
+    ``tune.measured_step_ms`` gauge either way, so ``tda report`` can
+    render predicted-vs-measured."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_distalg import tune as ttune
+    from tpu_distalg.models import ssgd
+    from tpu_distalg.parallel import parallelize
+    from tpu_distalg.utils import profiling
+
+    n_shards = int(mesh.shape["data"])
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    if profile is None:
+        profile = ensure_profile(backend="tpu" if on_tpu else "cpu")
+    res = ttune.resolve(profile, ttune.Workload(
+        d=d, n_workers=n_shards, transport="device",
+        n_shards=n_shards))
+    default_spec = str(ttune.defaults.DEFAULT_GEOMETRY["comm"])
+    tuned_spec = res.comm_string()
+
+    rng = np.random.default_rng(0)
+    rows = rows_per_shard * max(1, n_shards)
+    X = rng.standard_normal((rows, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0) \
+        .astype(np.float32)
+    Xs, ys = parallelize(X, mesh), parallelize(y, mesh)
+    Xt = jnp.zeros((1, d), jnp.float32)
+    yt = jnp.zeros((1,), jnp.float32)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    def rate(sched):
+        cfg = ssgd.SSGDConfig(n_iterations=steps, eval_test=False,
+                              comm=sched, mini_batch_fraction=1.0)
+        fn = ssgd.make_train_fn(mesh, cfg, Xs.n_padded, d=d)
+        if sched == "dense":
+            timed = lambda: fn(Xs.data, ys.data, Xs.mask,  # noqa: E731
+                               Xt, yt, w0)
+        else:
+            sync = ssgd._comm_sync(mesh, cfg, d)
+            res0 = jax.device_put(
+                jnp.asarray(sync.init_state()),
+                NamedSharding(mesh, P("data", None)))
+            timed = lambda: fn(Xs.data, ys.data, Xs.mask,  # noqa: E731
+                               Xt, yt, w0, res0)
+        return profiling.steps_per_sec(timed, steps=steps,
+                                       repeats=repeats,
+                                       with_stats=True)
+
+    default_rate, default_spread = rate(default_spec)
+    tevents.gauge("tune.measured_step_ms", 1e3 / default_rate)
+    line = {
+        "metric": "tuned_step_speedup",
+        "unit": "x",
+        "vs_baseline": None,
+        "tune_profile": profile["profile_id"],
+        "rig": profile.get("rig"),
+        "comm_default": default_spec,
+        "comm_tuned": tuned_spec,
+        "predicted_sync_ms": res.predicted_sync_ms(),
+        "default_steps_per_sec": round(default_rate, 2),
+        "d": d, "rows": rows, "n_shards": n_shards, "steps": steps,
+    }
+    if tuned_spec == default_spec or n_shards < 2:
+        emit({**line, "value": 1.0, "identical_geometry": True,
+              "steps_per_sec": round(default_rate, 2),
+              "note": "resolver chose the default geometry for this "
+                      "rig (no device interconnect worth compressing "
+                      "for), so both arms are the same compiled "
+                      "program — ratio 1.0 by construction, not two "
+                      "noise samples"})
+        return
+    tuned_rate, tuned_spread = rate(tuned_spec)
+    speedup = tuned_rate / default_rate
+    if speedup < 1.0:
+        raise RuntimeError(
+            f"resolved geometry ({tuned_spec}) measured SLOWER than "
+            f"the default ({tuned_rate:.2f} vs {default_rate:.2f} "
+            f"steps/s, {speedup:.3f}x) — the cost model mispredicted "
+            f"on this rig; refusing to record a sub-1.0 value under "
+            f"a floor-claimed metric")
+    emit({**line, "value": round(speedup, 3),
+          "identical_geometry": False,
+          "steps_per_sec": round(tuned_rate, 2),
+          "dense_spread": default_spread, "spread": tuned_spread,
+          "note": "full SSGD steps at the comm-bound geometry: "
+                  "cost-model-resolved schedule vs the default "
+                  "table, measured step time"})
+
+
+def run_cluster_tuned_push_pull_speedup(emit, *, profile=None,
+                                        fast=False):
+    """``cluster_tuned_push_pull_speedup`` — the autotuner's claim at
+    the CLUSTER tier: median push→commit→pull round trip on an
+    otherwise idle single-worker cluster, default geometry vs the
+    cost-model-resolved one (host-wire comm schedule, PS shard
+    count/mode, pull-refresh cadence), ratio = default p50 ÷ tuned
+    p50 (>1 = tuned is faster). When the resolver lands exactly on
+    the default table the second arm is skipped and the ratio is 1.0
+    with ``identical_geometry: true`` — same program, same honesty
+    rule as :func:`run_tuned_step_speedup`. Raises rather than
+    fabricating when an arm reports no push/pull timing."""
+    import dataclasses
+    import tempfile
+
+    from tpu_distalg import cluster as clus
+    from tpu_distalg import tune as ttune
+
+    if profile is None:
+        profile = ensure_profile()
+    task = clus.TrainTask(n_rows=1024 if fast else 4096)
+    res = ttune.resolve(profile, ttune.Workload(
+        d=task.n_features + 1, n_rows=task.n_rows, n_workers=1,
+        transport="host"))
+    base = clus.ClusterConfig(
+        n_slots=1, n_windows=8 if fast else 16, staleness=2,
+        heartbeat_timeout=3.0, train=task)
+    tuned_kw = {}
+    if res.source("comm") == "resolved":
+        tuned_kw["comm"] = res.comm_string()
+    for knob in ("ps_shards", "ps_mode", "pull_refresh_windows"):
+        if res.source(knob) == "resolved" \
+                and res.value(knob) is not None:
+            tuned_kw[knob] = res.value(knob)
+    tuned_kw = {k: v for k, v in tuned_kw.items()
+                if getattr(base, k) != v}
+
+    def p50(cfg, arm):
+        with tempfile.TemporaryDirectory(
+                prefix=f"tda_tuned_{arm}_") as ckpt:
+            r = clus.run_local_cluster(
+                dataclasses.replace(cfg, checkpoint_dir=ckpt),
+                spawn="thread", timeout=120.0)
+        stats = (r["worker_stats"] or {}).get(0) or {}
+        v = stats.get("push_pull_ms_p50")
+        if not v or not stats.get("pushes"):
+            raise RuntimeError(
+                f"{arm} arm reported no push/pull timing "
+                f"(stats={stats}) — refusing to fabricate a speedup")
+        return float(v)
+
+    base_p50 = p50(base, "default")
+    line = {
+        "metric": "cluster_tuned_push_pull_speedup",
+        "unit": "x",
+        "vs_baseline": None,
+        "tune_profile": profile["profile_id"],
+        "rig": profile.get("rig"),
+        "default_p50_ms": round(base_p50, 3),
+        "tuned_knobs": {k: str(v) for k, v in sorted(
+            tuned_kw.items())},
+        "n_windows": base.n_windows,
+    }
+    if not tuned_kw:
+        emit({**line, "value": 1.0, "identical_geometry": True,
+              "note": "resolver landed on the default table for this "
+                      "rig/workload — one arm measured, ratio 1.0 by "
+                      "construction"})
+        return
+    tuned_p50 = p50(dataclasses.replace(
+        base, tune_profile=profile["profile_id"], **tuned_kw),
+        "tuned")
+    emit({**line, "value": round(base_p50 / tuned_p50, 3),
+          "identical_geometry": False,
+          "tuned_p50_ms": round(tuned_p50, 3),
+          "note": "median push->commit->pull round trip on an idle "
+                  "single-worker cluster: cost-model-resolved "
+                  "geometry vs the default table"})
+
+
+def _bench_tuned_step(mesh, n_chips):
+    """The tuned-geometry step-time A/B — see
+    :func:`run_tuned_step_speedup`."""
+    run_tuned_step_speedup(mesh, _emit)
+
+
+def _bench_cluster_tuned(mesh, n_chips):
+    """The cluster-tier tuned-geometry A/B — see
+    :func:`run_cluster_tuned_push_pull_speedup`."""
+    run_cluster_tuned_push_pull_speedup(_emit)
 
 
 #: canonical device-reshard payload (the metric name carries it)
@@ -3029,6 +3297,8 @@ ALL_METRIC_NAMES = (
     "cluster_serve_availability",
     "cluster_sparse_pull_fraction",
     "pagerank_cluster_iters_per_sec",
+    "tuned_step_speedup",
+    "cluster_tuned_push_pull_speedup",
 )
 
 #: metrics where LOWER is better (latencies; the SSP steps-to-target
@@ -3076,6 +3346,8 @@ _METRIC_UNITS = {
     "reshard_1gb_gbps": "GB/s",
     "ssgd_2d_mesh_step_speedup": "x",
     "closure_10m_paths_per_sec": "paths/s",
+    "tuned_step_speedup": "x",
+    "cluster_tuned_push_pull_speedup": "x",
 }
 for _n in ALL_METRIC_NAMES:
     _METRIC_UNITS.setdefault(
@@ -3360,6 +3632,15 @@ def _run_cpu_fallback(reason: str, fast: bool = False) -> int:
             run_comm_step_speedup, mesh, _cpu_emit,
             **(dict(d=1 << 14, steps=4, repeats=1) if fast else {})))
     _phase_optional(
+        "cpu_tuned_step",
+        functools.partial(
+            run_tuned_step_speedup, mesh, _cpu_emit,
+            **(dict(d=1 << 14, steps=4, repeats=1) if fast else {})))
+    _phase_optional(
+        "cpu_cluster_tuned",
+        functools.partial(run_cluster_tuned_push_pull_speedup,
+                          _cpu_emit, fast=fast))
+    _phase_optional(
         "cpu_ssp",
         functools.partial(
             run_ssp_straggler_speedup, mesh, _cpu_emit,
@@ -3477,11 +3758,19 @@ def _run(args):
     # inside a 3 h window — the driver's rc-124 SIGKILL landed while
     # init was still spinning and the artifact parsed null); half the
     # remaining window is left for the bench proper.
+    # the rig's measured backend-init time (from the newest RigProfile,
+    # when `tda tune` has recorded one) re-prices both the per-attempt
+    # deadline and the retry count — r05 spent 26 min retrying against
+    # the worst-case cap on a rig whose healthy init takes seconds
+    rig_prof = _rig_profile()
+    init_s = ((rig_prof or {}).get("measurements")
+              or {}).get("backend_init_s")
     budget_retries = _init_retry_budget(
-        HARD_DEADLINE_SECONDS - (time.monotonic() - _T0))
+        HARD_DEADLINE_SECONDS - (time.monotonic() - _T0),
+        init_seconds=init_s)
     try:
         mesh = tsupervisor.init_backend(
-            timeout=INIT_TIMEOUT_SECONDS,
+            timeout=_init_attempt_timeout(init_s),
             retries=budget_retries,
             backoff=INIT_RETRY_SECONDS,
             backoff_cap=INIT_RETRY_SECONDS,
@@ -3506,6 +3795,11 @@ def _run(args):
                                    n_chips, args.comm)
             _phase("comm", _bench_comm, mesh, n_chips)
             _phase("comm_speedup", _bench_comm_speedup, mesh, n_chips)
+            # the autotuner's end-to-end A/B: raises (recorded) when
+            # the resolver mispredicts, never emits a sub-1.0 value
+            # under the floor-claimed metric
+            _phase_optional("tuned_step", _bench_tuned_step, mesh,
+                            n_chips)
             # optional: run_ssp_straggler_speedup raises rather than
             # emitting a fabricated 0.0 ratio when SSP misses the band
             _phase_optional("ssp", _bench_ssp, mesh, n_chips,
@@ -3514,6 +3808,10 @@ def _run(args):
             # construction, so it runs (honestly) on every backend;
             # raises rather than fabricating on an incomplete run
             _phase_optional("cluster", _bench_cluster, mesh, n_chips)
+            # the cluster-tier autotuner A/B (host wire, so it runs
+            # honestly on every backend)
+            _phase_optional("cluster_tuned", _bench_cluster_tuned,
+                            mesh, n_chips)
             # the serving plane rides the same host-thread honesty;
             # raises on an unfired kill or a bitwise divergence
             _phase_optional("cluster_serve", _bench_cluster_serve,

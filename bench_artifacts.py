@@ -13,16 +13,27 @@ import glob
 import json
 import os
 import re
+import socket
 
 
-def load_newest_metrics(search_dir: str, path: str | None = None):
+def load_newest_metrics(search_dir: str, path: str | None = None,
+                        rig: str | None = None):
     """``(artifact_name, {metric: value})`` from ``path`` or from the
     newest ``BENCH_r*.json`` under ``search_dir`` whose ``parsed``
     field carries metrics. Artifacts are tried newest-round first; one
     whose ``parsed`` is null (a run that died before any metric line)
     falls through to the previous round. Pre-summary artifacts carry a
     single metric line instead of the ``all_metrics`` map; both shapes
-    load. ``(None, {})`` when nothing parses."""
+    load. ``(None, {})`` when nothing parses.
+
+    ``rig`` is the CLAIMING rig (default: this hostname): an artifact
+    whose summary carries a DIFFERENT rig tag is skipped, like the
+    cpu-backend rounds — numbers measured on another machine are not
+    a reference this machine's claims or tripwire should reconcile
+    against. Artifacts predating the rig tag (no ``rig`` field) still
+    load. An explicit ``path`` always loads verbatim."""
+    if rig is None:
+        rig = socket.gethostname()
     if path is not None:
         paths = [path]
     else:
@@ -46,6 +57,12 @@ def load_newest_metrics(search_dir: str, path: str | None = None):
             # or the perf tripwire should reconcile against — fall
             # through to the newest real-backend artifact (an explicit
             # --artifact path still loads it)
+            continue
+        art_rig = parsed.get("rig")
+        if path is None and art_rig is not None and art_rig != rig:
+            # same honesty rule, generalized: a round measured on a
+            # DIFFERENT rig (the summary's rig tag) cannot anchor this
+            # rig's claims — tuned geometry especially is per-rig
             continue
         metrics = parsed.get("all_metrics")
         if not isinstance(metrics, dict):

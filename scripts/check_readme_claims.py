@@ -153,6 +153,19 @@ CLAIMS = [
      r"`--mesh-shape 2x2` runs \*\*([\d.]+?)×\+\*\* the 1-D", 1.0),
     ("closure_10m_paths_per_sec",
      r"closure at \*\*([\d\s]+?)\+\s*paths/s\*\*", 1.0),
+    # platform-aware autotuner (round 22): both A/B ratios claimed as
+    # FLOORS at the parity line — the resolver must never ship a
+    # geometry slower than the default table (the step phase RAISES
+    # on a sub-1.0 measurement rather than recording it; identical-
+    # geometry rounds record exactly 1.0). Only artifacts whose rig
+    # tag matches this machine reconcile: tuned geometry is per-rig
+    # (bench_artifacts skips mismatched-rig rounds like cpu rounds)
+    ("tuned_step_speedup",
+     r"`--tune auto` runs \*\*([\d.]+?)×\+\*\* the default-table "
+     r"step rate", 1.0),
+    ("cluster_tuned_push_pull_speedup",
+     r"tuned cluster geometry holds \*\*([\d.]+?)×\+\*\* the "
+     r"default-table push/pull rate", 1.0),
 ]
 
 #: claims stated as FLOORS ("×+"): the measured value may exceed the
@@ -172,6 +185,8 @@ FLOOR_CLAIMS = frozenset((
     "reshard_1gb_gbps",
     "ssgd_2d_mesh_step_speedup",
     "closure_10m_paths_per_sec",
+    "tuned_step_speedup",
+    "cluster_tuned_push_pull_speedup",
 ))
 
 #: claims stated as CEILINGS ("under X ms" — latency metrics, lower is

@@ -88,6 +88,13 @@ TDA114      WAL-before-ack at protocol scope: in any handler that
             both appends a record and sends a frame, the append
             dominates the send on every branch path (TDA091
             generalized beyond fsync syntax)
+TDA120      geometry-literal discipline (per-file, against the tuner
+            tables): a geometry knob (bucket elems, shard counts,
+            block sizes, pull-refresh cadence) pinned to an int
+            literal in ``tpu_distalg/models/`` or
+            ``tpu_distalg/cluster/`` must carry a value
+            ``tune/defaults.py`` spells, or a reasoned rig-pin — the
+            autotuner's resolver owns everything else
 ==========  =========================================================
 
 The TDA11x rows run over the protocol graph — the wire-contract slice
@@ -134,11 +141,12 @@ from tpu_distalg.analysis.telemetry_contract import (
     RULES as _TELEMETRY_CONTRACT,
 )
 from tpu_distalg.analysis.tracing import RULES as _TRACING
+from tpu_distalg.analysis.tune import RULES as _TUNE
 
 #: every shipped per-file rule, in code order
 RULES = tuple(sorted(
     _DETERMINISM + _TRACING + _CONCURRENCY + _SEAMS + _PALLAS + _COMMS
-    + _SERVE + _SSP + _PARTITION + _CLUSTER,
+    + _SERVE + _SSP + _PARTITION + _CLUSTER + _TUNE,
     key=lambda r: r.code))
 
 #: the interprocedural family — runs once over the project graph

@@ -186,6 +186,17 @@ def _add_telemetry(p):
                         "segment:run; kinds: oserror, hang, corrupt, "
                         "kill). The same plan+seed replays the same "
                         "failure sequence bitwise — see 'tda chaos'")
+    p.add_argument("--tune", type=str, default="off", metavar="MODE",
+                   help="platform-aware geometry (tpu_distalg/tune/): "
+                        "'off' = the hand-pinned default tables, "
+                        "'auto' = resolve comm schedule, bucket "
+                        "elems, mesh shape, ps-shards/mode, block "
+                        "sizes and pull-refresh cadence from this "
+                        "rig's newest measured profile (run 'tda "
+                        "tune' once), or a RIGPROFILE_*.json path. "
+                        "Explicit flags always win; every resolved "
+                        "knob logs a tune.* event with its WHY. "
+                        "Tuning changes geometry, never determinism")
 
 
 def _add_ckpt(p, every_default):
@@ -526,6 +537,13 @@ def main(argv=None):
                         "arrays and merge row-wise with per-row "
                         "versions — sparse pulls/pushes for models "
                         "bigger than one host")
+    p.add_argument("--pull-refresh-windows", type=int, default=None,
+                   metavar="N",
+                   help="compressed-pull refresh cadence: every Nth "
+                        "commit ships a dense version-pinned pull "
+                        "bounding the pull-noise random walk "
+                        "(default: the tuner's table value; --tune "
+                        "auto re-derives it from the measured wire)")
     p.add_argument("--policy", default="elastic",
                    choices=["elastic", "restart"],
                    help="death handling: elastic = continue at "
@@ -650,6 +668,40 @@ def main(argv=None):
     _add_telemetry(p)
 
     p = sub.add_parser(
+        "tune",
+        help="measure this rig — framed-TCP loopback bandwidth/RTT, "
+             "host memcpy, matmul FLOP/s, host RAM, per---comm codec "
+             "throughput, backend init time, optionally a device "
+             "collective — and persist a versioned rig-tagged "
+             "RigProfile JSON; every subcommand's '--tune auto' then "
+             "resolves its geometry from the newest profile via the "
+             "cost model (tune/resolve.py)")
+    p.add_argument("--out-dir", type=str, default=None, metavar="DIR",
+                   help="profile directory (default $TDA_PROFILE_DIR "
+                        "or ./.tda_profiles)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="measurement seed (profiles are seeded and "
+                        "deterministic modulo the measured timings)")
+    p.add_argument("--quick", action="store_true",
+                   help="smaller working sets (smoke/CI tier; the "
+                        "artifact records quick=true)")
+    p.add_argument("--no-backend-init", action="store_true",
+                   help="skip the subprocess-timed backend init "
+                        "measurement (the slowest pass)")
+    p.add_argument("--collective", action="store_true",
+                   help="also measure a device collective (imports "
+                        "jax and builds the mesh; omit for the "
+                        "jax-free host-only profile)")
+    p.add_argument("--n-slices", type=int, default=0,
+                   help="with --collective: data-axis size; 0 = all "
+                        "devices")
+    _add_mesh_shape(p)
+    p.add_argument("--telemetry-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="record the profiling pass as telemetry "
+                        "events (a 'tune' span)")
+
+    p = sub.add_parser(
         "lint",
         help="static analysis for the framework's own invariants "
              "(TDA0xx rules: determinism, trace purity, concurrency, "
@@ -709,6 +761,14 @@ def main(argv=None):
         telemetry.configure(args.telemetry_dir)
         return lint_cli.run_protocol(args)
 
+    if args.cmd == "tune":
+        # host-only measurement — jax-free unless --collective asks
+        # for the device pass
+        from tpu_distalg import telemetry
+
+        telemetry.configure(args.telemetry_dir)
+        return _run_tune(args)
+
     if args.cmd == "report":
         # pure log analysis — no backend, no mesh, no jax import
         from tpu_distalg.telemetry import report as treport
@@ -748,6 +808,11 @@ def main(argv=None):
         # boundary to save at, and swallowing its SIGTERM/first-SIGINT
         # would make it HARDER to stop, not more graceful.
         faults.preempt.install()
+
+    # platform-aware geometry: BEFORE the jax import and mesh build
+    # (the resolver may set --mesh-shape) and with the raw argv in
+    # hand so explicitly spelled flags win over resolved values
+    _apply_tune(args, argv if argv is not None else sys.argv[1:])
 
     if args.emulate:
         from tpu_distalg.parallel.mesh import emulate_devices
@@ -799,6 +864,147 @@ def main(argv=None):
             hb.stop()
 
 
+#: --tune knob -> (argparse dest, the CLI option strings that mark it
+#: explicitly spelled). A knob is applied only where the subcommand
+#: actually grew the flag; explicit flags always win.
+_TUNE_FLAG_KNOBS = (
+    ("comm", "comm", ("--comm",)),
+    ("mesh_shape", "mesh_shape", ("--mesh-shape",)),
+    ("ps_shards", "ps_shards", ("--ps-shards",)),
+    ("ps_mode", "ps_mode", ("--ps-mode",)),
+    ("block_rows", "block_rows", ("--block-rows",)),
+    ("block_edges", "block_edges", ("--block-edges",)),
+    ("pull_refresh_windows", "pull_refresh_windows",
+     ("--pull-refresh-windows",)),
+)
+
+
+def _spelled_options(argv) -> set:
+    """The long-option strings the user actually typed (``--opt`` and
+    ``--opt=value`` spellings both count)."""
+    return {a.split("=", 1)[0] for a in argv if a.startswith("--")}
+
+
+def _tune_workload(args, ttune):
+    """The workload descriptor the resolver prices this subcommand
+    against."""
+    if args.cmd == "cluster":
+        # the coordinator's TrainTask: breast-cancer-shaped synthetic
+        # two-class rows (30 features + bias), host TCP wire
+        return ttune.Workload(
+            d=31, n_rows=getattr(args, "n_rows", 0) or 0,
+            n_workers=getattr(args, "workers", None)
+            or ttune.defaults.CLUSTER_SLOTS,
+            family="data", transport="host")
+    family = {"kmeans": "kmeans", "als": "als", "pagerank": "graph",
+              "closure": "graph"}.get(args.cmd, "data")
+    # the reference task's model dim (breast-cancer: 30 features +
+    # bias); graph/kmeans block knobs scale from bandwidth, not d
+    return ttune.Workload(
+        d=31, n_rows=getattr(args, "n_rows", 0) or 0,
+        family=family, transport="device",
+        n_shards=getattr(args, "n_slices", 0) or None)
+
+
+def _apply_tune(args, argv) -> None:
+    """Resolve ``--tune`` into the args namespace (tentpole wiring):
+    load the requested profile, price the workload, and overwrite
+    every resolved knob the subcommand exposes — except knobs the
+    user explicitly spelled, which always win. Logged per knob as
+    ``tune.*`` telemetry with the WHY."""
+    mode = getattr(args, "tune", "off") or "off"
+    if mode == "off":
+        return
+    import socket
+
+    from tpu_distalg import tune as ttune
+
+    if mode == "auto":
+        profile, _ = ttune.newest_profile(rig=socket.gethostname())
+        if profile is None:
+            print("tda --tune auto: no profile for this rig (run "
+                  "'tda tune' once); table defaults stand",
+                  file=sys.stderr)
+            return
+    else:
+        try:
+            profile = ttune.load_profile(mode)
+        except ttune.ProfileError as e:
+            raise SystemExit(f"--tune: {e}")
+    spelled = _spelled_options(argv)
+    explicit = {
+        knob: getattr(args, dest)
+        for knob, dest, opts in _TUNE_FLAG_KNOBS
+        if hasattr(args, dest) and any(o in spelled for o in opts)}
+    res = ttune.resolve(profile, _tune_workload(args, ttune),
+                        explicit=explicit)
+    for knob, dest, _opts in _TUNE_FLAG_KNOBS:
+        if not hasattr(args, dest):
+            continue
+        c = res.choices[knob]
+        if c.source != "resolved" or c.value is None:
+            continue
+        setattr(args, dest,
+                res.comm_string() if knob == "comm" else c.value)
+    args._tune_profile_id = res.profile_id
+    ttune.emit_resolution(res)
+    if not getattr(args, "quiet", False):
+        for knob in ttune.KNOBS:
+            c = res.choices[knob]
+            print(f"tune[{knob}]: {c.value} ({c.source}) {c.why}",
+                  file=sys.stderr)
+
+
+def _run_tune(args):
+    """``tda tune`` — the seeded profiling pass: measure the rig,
+    persist the versioned rig-tagged RigProfile artifact."""
+    import os
+
+    from tpu_distalg import telemetry
+    from tpu_distalg import tune as ttune
+
+    collective = None
+    backend = (os.environ.get("JAX_PLATFORMS") or "cpu"
+               ).split(",")[0] or "cpu"
+    with telemetry.span("cli:tune"):
+        if args.collective:
+            import jax
+
+            backend = jax.default_backend()
+            collective = ttune.measure_collective(_mesh(args))
+        m = ttune.measure_rig(
+            seed=args.seed, quick=args.quick,
+            include_backend_init=not args.no_backend_init,
+            collective=collective)
+        # the one wall-clock read: created_unix orders profile
+        # artifacts on disk and tags when the rig was measured — it
+        # never influences run behavior or replay
+        created = time.time()  # tda: ignore[TDA001] -- artifact timestamp, not run state
+        profile = ttune.build_profile(
+            m, created_unix=created, seed=args.seed, backend=backend)
+        path = ttune.save_profile(profile, args.out_dir)
+    lb = m["loopback"]
+    print(f"tune: rig={profile['rig']} backend={backend} "
+          f"id={profile['profile_id']}")
+    print(f"tune: loopback {lb['bandwidth_bytes_s'] / 1e6:.0f} MB/s "
+          f"rtt {lb['rtt_s'] * 1e6:.0f}us | memcpy "
+          f"{m['memcpy_bytes_s'] / 1e9:.1f} GB/s | matmul "
+          f"{m['matmul_flops_s'] / 1e9:.1f} GFLOP/s")
+    for name, rates in sorted(m["codecs"].items()):
+        print(f"tune: codec {name}: encode "
+              f"{rates['encode_elems_s'] / 1e6:.1f} Melem/s, decode "
+              f"{rates['decode_elems_s'] / 1e6:.1f} Melem/s")
+    if collective:
+        print(f"tune: collective "
+              f"{collective['bandwidth_bytes_s'] / 1e6:.0f} MB/s "
+              f"rtt {collective['rtt_s'] * 1e6:.0f}us over "
+              f"{collective['n_shards']} shards")
+    if m.get("backend_init_s") is not None:
+        print(f"tune: backend init {m['backend_init_s']:.1f}s")
+    print(f"tune: wrote {path}")
+    return 0
+
+
 def _run_cluster(args):
     """``tda cluster`` — the multi-process elastic runtime."""
     import json as _json
@@ -832,6 +1038,9 @@ def _run_cluster(args):
     train = (clus.TrainTask(**_json.loads(args.train_json))
              if args.train_json
              else clus.TrainTask(algo=args.algo, n_rows=args.n_rows))
+    extra = {}
+    if args.pull_refresh_windows is not None:
+        extra["pull_refresh_windows"] = args.pull_refresh_windows
     cfg = clus.ClusterConfig(
         n_slots=args.workers, n_windows=args.n_windows,
         staleness=spec.staleness, decay=spec.decay,
@@ -843,7 +1052,9 @@ def _run_cluster(args):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         policy=args.policy, plan_spec=plan, comm=args.comm,
-        ps_mode=args.ps_mode, train=train)
+        ps_mode=args.ps_mode,
+        tune_profile=getattr(args, "_tune_profile_id", None),
+        train=train, **extra)
     if args.role == "coordinator":
         coord = clus.Coordinator(cfg).start()
         print(f"cluster_coordinator: listening on "

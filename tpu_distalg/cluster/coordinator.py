@@ -82,6 +82,7 @@ from tpu_distalg.parallel.ssp import (
     DEFAULT_STALENESS,
 )
 from tpu_distalg.telemetry import events as tevents
+from tpu_distalg.tune import defaults as tune_defaults
 
 #: how often the accept loop wakes to scan for stale heartbeats
 POLL_SECONDS = 0.05
@@ -100,8 +101,12 @@ PULL_SEED_TAG = pcomms.PULL_SEED_TAG
 #: sqrt(REFRESH) · scale instead of letting a long run's workers
 #: train against an ever-worse center. Amortized wire cost: 4d/16 =
 #: 0.25 bytes/elem/window on top of int8's ~1 — the reduction claim
-#: survives. A pure function of cv, so replays are unaffected.
-PULL_REFRESH_WINDOWS = 16
+#: survives. A pure function of cv, so replays are unaffected. The
+#: default cadence lives in the tuner's geometry table
+#: (``tune/defaults.py``); ``ClusterConfig.pull_refresh_windows``
+#: overrides it per run (the autotuner's resolver re-derives the
+#: cadence from the measured wire).
+PULL_REFRESH_WINDOWS = tune_defaults.PULL_REFRESH_WINDOWS
 
 FREE, ACTIVE, DEAD = "free", "active", "dead"
 
@@ -210,6 +215,16 @@ class ClusterConfig:
     #: per-row versions: pushes carry ``{leaf}.rows`` index arrays and
     #: merge row-wise — see ``cluster/rowstore.py``)
     ps_mode: str = "replicated"
+    #: compressed-pull refresh cadence — every Nth commit ships a
+    #: dense version-pinned pull (see :data:`PULL_REFRESH_WINDOWS`).
+    #: The autotuner's resolver re-derives this from the measured
+    #: wire; a pure function of cv either way, so replays and the
+    #: bitwise determinism contract are unaffected by the value.
+    pull_refresh_windows: int = tune_defaults.PULL_REFRESH_WINDOWS
+    #: the rig profile id this config's geometry was resolved from
+    #: (``None`` = untuned table defaults) — carried into the welcome
+    #: meta so worker logs can name the profile that shaped the run
+    tune_profile: str | None = None
     train: TrainTask = dataclasses.field(default_factory=TrainTask)
 
     def __post_init__(self):
@@ -222,6 +237,10 @@ class ClusterConfig:
         if self.staleness < 1:
             raise ValueError(
                 f"staleness must be >= 1, got {self.staleness}")
+        if self.pull_refresh_windows < 1:
+            raise ValueError(
+                f"pull_refresh_windows must be >= 1, got "
+                f"{self.pull_refresh_windows}")
         # parse-validate eagerly: an unknown/deviceless schedule must
         # fail at config time, not in a worker subprocess mid-join
         pcomms.make_host_codec(self.comm)
@@ -939,6 +958,8 @@ class Coordinator:
             "rpc_deadline": self.cfg.rpc_deadline,
             "comm": self.cfg.comm,
             "ps_mode": self.cfg.ps_mode,
+            "pull_refresh": self.cfg.pull_refresh_windows,
+            "tune_profile": self.cfg.tune_profile,
             "plan": self.cfg.plan_spec,
             "train": self.task.as_meta(),
             "done": self.done,
@@ -1098,8 +1119,9 @@ class Coordinator:
         if self._codec is None:
             return ("center", self._status_meta(), self.ps.snapshot())
         cv = window + 1
+        refresh = self.cfg.pull_refresh_windows
         if have is not None and int(have) < cv \
-                and cv % PULL_REFRESH_WINDOWS:
+                and cv % refresh:
             delta = self.ps.delta_since(int(have), cv)
             if delta is not None:
                 arrays, _ = pcomms.encode_tree(
@@ -1126,7 +1148,7 @@ class Coordinator:
         else:   # no history at all (dense-depth 0 cannot reach here)
             meta["cv"] = self.version
             snap = self.ps.snapshot()
-        if not cv % PULL_REFRESH_WINDOWS:
+        if not cv % refresh:
             tevents.counter("cluster.pull_refreshes")
         else:
             tevents.counter("cluster.pull_dense_fallbacks")

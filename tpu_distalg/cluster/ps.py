@@ -36,6 +36,7 @@ import numpy as np
 
 from tpu_distalg.parallel import partition
 from tpu_distalg.parallel.ssp import DEFAULT_DECAY
+from tpu_distalg.tune import defaults as tune_defaults
 
 PS_MODES = ("replicated", "rowstore")
 
@@ -123,7 +124,8 @@ class ParameterServer:
     the depth at 0: zero overhead, trajectories pinned to history."""
 
     def __init__(self, center: dict, *, table: str = "lr",
-                 n_shards: int = 2, decay: float = DEFAULT_DECAY,
+                 n_shards: int = tune_defaults.PS_SHARDS,
+                 decay: float = DEFAULT_DECAY,
                  history_depth: int = 0, mode: str = "replicated",
                  row_staleness: int | None = None):
         if mode not in PS_MODES:

@@ -70,6 +70,7 @@ from tpu_distalg.parallel import comms as pcomms
 from tpu_distalg.parallel import partition
 from tpu_distalg.parallel.ssp import DEFAULT_DECAY
 from tpu_distalg.telemetry import events as tevents
+from tpu_distalg.tune import defaults as tune_defaults
 
 #: schedule cell code for a kill (hang cells hold seconds)
 KILL_CELL = -1.0
@@ -133,7 +134,8 @@ class RowStore:
     have admitted."""
 
     def __init__(self, center: dict, *, table: str = "lr",
-                 n_shards: int = 2, decay: float = DEFAULT_DECAY,
+                 n_shards: int = tune_defaults.PS_SHARDS,
+                 decay: float = DEFAULT_DECAY,
                  staleness: int | None = None):
         self.map = partition.RowOwnershipMap.for_center(
             center, table, n_shards)

@@ -86,6 +86,7 @@ from tpu_distalg.parallel import ssp as pssp
 from tpu_distalg.telemetry import events as tevents
 from tpu_distalg.telemetry import heartbeat as theartbeat
 from tpu_distalg.telemetry.supervisor import supervised
+from tpu_distalg.tune import defaults as tune_defaults
 
 #: per-slot sampling-seed stride: slots draw independent minibatches
 SLOT_SEED_STRIDE = 1_000_003
@@ -657,6 +658,14 @@ def run_worker(host: str, port: int, *, slot: int | None = None,
     # graph/ALS workloads (``rowstore.run_cluster_pagerank``,
     # ``models/als.fit_rowstore``)
     ps_mode = meta.get("ps_mode") or "replicated"
+    # the welcome also names the tuned geometry this run was resolved
+    # under — the pull-refresh cadence (the coordinator enforces it;
+    # recorded here so worker stats say what wire they measured) and
+    # the rig-profile id (or None for untuned table defaults)
+    stats["pull_refresh"] = int(meta.get("pull_refresh")
+                                or tune_defaults.PULL_REFRESH_WINDOWS)
+    if meta.get("tune_profile"):
+        stats["tune_profile"] = str(meta["tune_profile"])
     overlap_push = codec is not None and comm_spec.overlap
     push_link = (_Link(host, port, None, connect, ident, rpc_deadline,
                        stats, log) if overlap_push else None)

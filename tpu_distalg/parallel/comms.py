@@ -779,51 +779,71 @@ class CommSync:
         widening happens locally after the exchange, never on the
         wire; PR 5's int32-psum caveat is closed, and lint rule TDA051
         keeps it closed)."""
-        n = self.n_shards
         dense_elems = sum(
             s for s, e in zip(self._sizes, self._eligible_mask)
             if not e)
-        ce = self.ef_elems  # compressible elements
-        ring = 2.0 * (n - 1) / n if n > 1 else 0.0
-        b_logical = 4 * (ce + dense_elems)
-        dense_wire = 4 * dense_elems * ring
-        sched = self.spec.schedule
-        if sched == "dense" or n == 1:
-            wire = 4 * ce * ring + dense_wire
-            rounds = 1
-        elif sched == "bf16":
-            wire = 2 * ce * ring + dense_wire
-            rounds = 1 + (1 if dense_elems else 0)
-        elif sched == "int8":
-            # native ring: int8 both phases (scatter (n−1)/n + gather
-            # (n−1)/n = the ring constant at 1 byte/elem), one f32
-            # pmax per BUCKET for the shared scale (the requant scale
-            # n·s is derived, no extra collective)
-            nb = max(1, math.ceil(max(1, ce) / self.spec.bucket_elems))
-            wire = ce * ring + 4 * nb * ring + dense_wire
-            rounds = 3 * nb + (1 if dense_elems else 0)
-        elif sched == "topk":
-            k = max(1, int(round(self.spec.topk_fraction * max(1, ce))))
-            # k (value, index) pairs exchanged all-gather-style
-            wire = 8 * k * (n - 1) + dense_wire
-            rounds = 1 + (1 if dense_elems else 0)
-        elif sched == "bucketed":
-            wire = 4 * ce * ring + dense_wire
-            rounds = max(1, math.ceil(
-                max(1, ce) / self.spec.bucket_elems)) \
-                + (1 if dense_elems else 0)
-        elif sched == "hier":
-            g = self.groups
-            m = max(1, n // g)
-            ici = 4 * ce * (2.0 * (m - 1) / m if m > 1 else 0.0)
-            dcn = 4 * (ce / m) * (2.0 * (g - 1) / g if g > 1 else 0.0)
-            wire = ici + dcn + dense_wire
-            rounds = 3 + (1 if dense_elems else 0)
-        else:  # pragma: no cover
-            raise AssertionError(sched)
-        return {"bytes_wire": int(round(wire)),
-                "bytes_logical": int(round(b_logical)),
-                "rounds": int(rounds)}
+        return schedule_stats(
+            self.spec.schedule, n_shards=self.n_shards,
+            compressible_elems=self.ef_elems, dense_elems=dense_elems,
+            bucket_elems=self.spec.bucket_elems,
+            topk_fraction=self.spec.topk_fraction, groups=self.groups)
+
+
+def schedule_stats(schedule: str, *, n_shards: int,
+                   compressible_elems: int, dense_elems: int = 0,
+                   bucket_elems: int = 1 << 16,
+                   topk_fraction: float = 0.01,
+                   groups: int = 1) -> dict:
+    """The closed-form per-sync byte/round accounting of one schedule
+    — ``CommSync.stats`` minus the live sync object, callable from a
+    plain parameter set (numpy-free, jax-free).
+
+    This module-level spelling exists for the autotuner: the
+    ``tune/resolve.py`` cost model joins these counts against a
+    measured :mod:`tpu_distalg.tune.profile` (wire bandwidth, RTT,
+    codec throughput) to predict per-sync seconds per candidate
+    schedule, so the resolver and the live accounting can never
+    disagree about what a schedule moves."""
+    n = n_shards
+    ce = compressible_elems
+    ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+    b_logical = 4 * (ce + dense_elems)
+    dense_wire = 4 * dense_elems * ring
+    if schedule == "dense" or n == 1:
+        wire = 4 * ce * ring + dense_wire
+        rounds = 1
+    elif schedule == "bf16":
+        wire = 2 * ce * ring + dense_wire
+        rounds = 1 + (1 if dense_elems else 0)
+    elif schedule == "int8":
+        # native ring: int8 both phases (scatter (n−1)/n + gather
+        # (n−1)/n = the ring constant at 1 byte/elem), one f32
+        # pmax per BUCKET for the shared scale (the requant scale
+        # n·s is derived, no extra collective)
+        nb = max(1, math.ceil(max(1, ce) / bucket_elems))
+        wire = ce * ring + 4 * nb * ring + dense_wire
+        rounds = 3 * nb + (1 if dense_elems else 0)
+    elif schedule == "topk":
+        k = max(1, int(round(topk_fraction * max(1, ce))))
+        # k (value, index) pairs exchanged all-gather-style
+        wire = 8 * k * (n - 1) + dense_wire
+        rounds = 1 + (1 if dense_elems else 0)
+    elif schedule == "bucketed":
+        wire = 4 * ce * ring + dense_wire
+        rounds = max(1, math.ceil(max(1, ce) / bucket_elems)) \
+            + (1 if dense_elems else 0)
+    elif schedule == "hier":
+        g = max(1, groups)
+        m = max(1, n // g)
+        ici = 4 * ce * (2.0 * (m - 1) / m if m > 1 else 0.0)
+        dcn = 4 * (ce / m) * (2.0 * (g - 1) / g if g > 1 else 0.0)
+        wire = ici + dcn + dense_wire
+        rounds = 3 + (1 if dense_elems else 0)
+    else:  # pragma: no cover
+        raise AssertionError(schedule)
+    return {"bytes_wire": int(round(wire)),
+            "bytes_logical": int(round(b_logical)),
+            "rounds": int(rounds)}
 
 
 def make_sync(spec, mesh, example, *, axis_name: str = DATA_AXIS):

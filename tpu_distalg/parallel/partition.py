@@ -573,6 +573,29 @@ def reshard_stats(tree, src_tbl, dst_tbl, mesh, *,
             "src": src_t.name, "dst": dst_t.name}
 
 
+def row_block_stats(n_rows: int, block_rows: int, *,
+                    n_shards: int = 1, row_bytes: int = 4) -> dict:
+    """Out-of-core row-block accounting (pure arithmetic, no mesh):
+    how many gathered blocks a ``block_rows`` granularity yields per
+    shard, the pad rows divisibility costs, and the per-block wire
+    bytes. The autotuner's block-rows chooser joins this against the
+    measured copy bandwidth; it is the block-granularity sibling of
+    :func:`reshard_stats`'s ``bytes_padding`` accounting."""
+    n_rows = max(1, int(n_rows))
+    block_rows = max(1, int(block_rows))
+    n_shards = max(1, int(n_shards))
+    per_shard = -(-n_rows // n_shards)             # ceil
+    n_blocks = -(-per_shard // block_rows)
+    padded = n_blocks * block_rows * n_shards
+    pad_rows = padded - n_rows
+    return {"n_blocks": int(n_blocks),
+            "rows_per_shard": int(per_shard),
+            "padded_rows": int(padded),
+            "pad_rows": int(pad_rows),
+            "waste_fraction": float(pad_rows) / float(padded),
+            "block_bytes": int(block_rows) * int(row_bytes)}
+
+
 def reshard(tree, src_tbl, dst_tbl, mesh, *, emit: bool = True,
             true_shapes: dict | None = None):
     """Re-lay ``tree`` out from ``src_tbl``'s placement to

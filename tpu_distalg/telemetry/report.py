@@ -410,6 +410,30 @@ def render(s: dict) -> str:
                 f"(host round-trip avoided: "
                 f"{c.get('reshard.bytes_host_avoided', 0) / 1e6:.1f}"
                 f" MB)")
+        n_res = s["counters"].get("tune.knobs_resolved", 0)
+        n_exp = s["counters"].get("tune.knobs_explicit", 0)
+        n_def = s["counters"].get("tune.knobs_defaulted", 0)
+        if n_res or n_exp or n_def:
+            # platform-aware autotuner (tpu_distalg/tune/): which rig
+            # profile shaped this run's geometry, how many knobs came
+            # from the cost model vs explicit flags vs the default
+            # tables, and — when the run measured itself — the
+            # predicted-vs-measured step delta (the cost model's
+            # honesty check; per-knob WHYs live in the tune_knob
+            # events)
+            g = s["gauges"]
+            line = (f"tune: profile {g.get('tune.profile', '?')}, "
+                    f"{n_res} knob(s) resolved / {n_exp} explicit / "
+                    f"{n_def} defaulted")
+            pred = g.get("tune.predicted_step_ms")
+            meas = g.get("tune.measured_step_ms")
+            if pred is not None:
+                line += f", predicted sync {pred:.3f} ms"
+            if meas is not None:
+                line += f", measured step {meas:.3f} ms"
+            if pred is not None and meas is not None and meas:
+                line += f" ({pred / meas:.2f}x predicted/measured)"
+            lines.append(line)
     if s["gauges"]:
         lines.append("gauges: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["gauges"].items())))
